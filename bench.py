@@ -1,0 +1,268 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Primary metric (BASELINE.md): cold-pull→HBM wall-clock / MB/s/chip sustained.
+
+This drives the REAL pipeline end-to-end, staging the north-star scenario
+("cold-pull→HBM from a warm peer, ≥3× faster than hf-cli + restore"):
+
+  setup   a loopback fake HF hub serves a synthetic multi-shard bf16
+          safetensors checkpoint; a *peer node* pulls it warm (untimed) and
+          serves its content-addressed store over the native /peer API;
+  ours    a cold node pulls the model with the peer configured
+          (registry walk → peer DCN fetch → C++ chunk store → HBM sink:
+          per-tensor range reads → `jax.device_put` under a NamedSharding)
+          — timed start→arrays-on-device;
+  control the `huggingface-cli + restore` analogue: stream the same files
+          from the hub to disk, read them back whole, parse, `device_put`
+          — timed the same way.
+
+`vs_baseline` = control/ours speedup (>1 means we beat the baseline path).
+Falls back to a pure device-ingest microbench if the native plane cannot
+build (keeps the driver's bench step alive on a broken toolchain).
+
+Env knobs: DEMODEL_BENCH_MB (default 256), DEMODEL_BENCH_SHARDS (default 4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from http.server import ThreadingHTTPServer
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))  # tests/ holds the fake-hub fixture
+
+TOTAL_MB = int(os.environ.get("DEMODEL_BENCH_MB", "256"))
+N_SHARDS = int(os.environ.get("DEMODEL_BENCH_SHARDS", "4"))
+MODEL = "bench/llama-synthetic"
+
+
+def _build_repo(total_mb: int, n_shards: int) -> dict[str, bytes]:
+    """filename → bytes: an n-shard bf16 checkpoint of ~total_mb MB."""
+    import ml_dtypes
+
+    from demodel_tpu.formats import safetensors as st
+
+    cols = 4096
+    rows = total_mb * (1 << 20) // 2 // n_shards // 2 // cols  # 2 tensors/shard
+    files: dict[str, bytes] = {
+        "config.json": json.dumps({"model_type": "llama", "hidden_size": cols}).encode(),
+    }
+    weight_map: dict[str, str] = {}
+    rng = np.random.default_rng(0)
+    for i in range(n_shards):
+        fname = f"model-{i + 1:05d}-of-{n_shards:05d}.safetensors"
+        tensors = {}
+        for j in range(2):
+            name = f"blocks.{i}.w{j}"
+            tensors[name] = rng.standard_normal((rows, cols), np.float32).astype(
+                ml_dtypes.bfloat16
+            )
+            weight_map[name] = fname
+        files[fname] = st.serialize(tensors)
+    files["model.safetensors.index.json"] = json.dumps(
+        {"metadata": {}, "weight_map": weight_map}
+    ).encode()
+    return files
+
+
+def _bench_e2e() -> dict:
+    import jax
+
+    from demodel_tpu.config import ProxyConfig
+    from demodel_tpu.delivery import pull
+    from demodel_tpu.formats import safetensors as st  # noqa: F401 (control path)
+    from demodel_tpu.proxy import ProxyServer
+    from tests.fake_registries import make_hf_handler
+
+    import requests
+
+    repo_files = _build_repo(TOTAL_MB, N_SHARDS)
+    weight_bytes = sum(
+        len(v) for k, v in repo_files.items() if k.endswith(".safetensors")
+    )
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        hub = ThreadingHTTPServer(
+            ("127.0.0.1", 0), make_hf_handler({MODEL: repo_files})
+        )
+        import threading
+
+        threading.Thread(target=hub.serve_forever, daemon=True).start()
+        endpoint = f"http://127.0.0.1:{hub.server_address[1]}"
+
+        def node_cfg(name: str) -> ProxyConfig:
+            return ProxyConfig(
+                host="127.0.0.1", port=0, mitm_hosts=[],
+                cache_dir=tmp / f"{name}-cache", data_dir=tmp / f"{name}-data",
+                use_ecdsa=True,
+            )
+
+        try:
+            # ---- warm the peer (untimed) and serve its store over /peer
+            cfg_a = node_cfg("peer")
+            pull(MODEL, cfg_a, endpoint=endpoint)
+            with ProxyServer(cfg_a, verbose=False) as peer_node:
+                # warm up jax (compile/alloc/dtype paths) before timing —
+                # both contenders transfer bf16, so neither pays first-use
+                # setup inside its window
+                import ml_dtypes as _md
+
+                jax.block_until_ready(
+                    jax.device_put(np.zeros((1024, 1024), np.float32))
+                )
+                jax.block_until_ready(
+                    jax.device_put(np.zeros((256, 4096), _md.bfloat16))
+                )
+
+                # ---- ours: cold node, warm peer → HBM. Streaming pull:
+                # shards land on device while later shards still transfer;
+                # finish() blocks until every tensor is resident.
+                from demodel_tpu.delivery import pull_to_hbm
+
+                # clock = cold start → every tensor resident in HBM; cache
+                # persistence continues on the finalizer, off the clock
+                # (joined below, untimed — matching the north-star metric)
+                t0 = time.perf_counter()
+                report, placed = pull_to_hbm(
+                    MODEL, node_cfg("cold"), endpoint=endpoint,
+                    peers=[peer_node.url], defer_cache_commit=True,
+                )
+                ours = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                placed.finalize()
+                finalize_secs = time.perf_counter() - t0
+                if os.environ.get("DEMODEL_BENCH_PROFILE"):
+                    print(f"[profile] ours total={ours:.3f}s "
+                          f"pull={report.get('secs')}s "
+                          f"sink={report.get('tpu_sink', {}).get('secs')}s "
+                          f"finalize(untimed)={finalize_secs:.3f}s "
+                          f"files={[round(f['secs'], 3) for f in report['files']]}",
+                          file=sys.stderr)
+                assert placed is not None and len(placed.arrays) == 2 * N_SHARDS
+                # correctness gate: the landed bytes must equal the source
+                blob = repo_files[f"model-00001-of-{N_SHARDS:05d}.safetensors"]
+                spec = st.parse_header(blob).tensors["blocks.0.w0"]
+                src = spec.to_numpy(blob[spec.start:spec.end])
+                got = np.asarray(placed.arrays["blocks.0.w0"])
+                if not np.array_equal(got, src):
+                    raise AssertionError("delivered tensor != source bytes")
+
+            # ---- control: hf-cli + restore analogue (hub → disk → device)
+            dl = tmp / "control"
+            dl.mkdir()
+            t0 = time.perf_counter()
+            sess = requests.Session()
+            names = [n for n in repo_files if n.endswith(".safetensors")]
+            for name in ["config.json", "model.safetensors.index.json"] + names:
+                r = sess.get(f"{endpoint}/{MODEL}/resolve/main/{name}", stream=True)
+                r.raise_for_status()
+                with open(dl / name.replace("/", "_"), "wb") as f:
+                    for chunk in r.iter_content(1 << 20):
+                        f.write(chunk)
+            arrs = []
+            for name in names:
+                blob = (dl / name).read_bytes()
+                idx = st.parse_header(blob)
+                for spec in idx.tensors.values():
+                    arrs.append(jax.device_put(spec.to_numpy(blob[spec.start:spec.end])))
+            jax.block_until_ready(arrs)
+            control = time.perf_counter() - t0
+        finally:
+            hub.shutdown()
+
+    mb = weight_bytes / 1e6
+    return {
+        "metric": "cold_pull_to_hbm_throughput",
+        "value": round(mb / ours, 2),
+        "unit": "MB/s/chip",
+        "vs_baseline": round(control / ours, 3),
+    }
+
+
+# ---------------------------------------------------------------- fallback
+
+
+def _bench_fallback() -> dict:
+    """Pure device-ingest microbench (no native plane): streamed device_put
+    vs write-to-disk-then-load, same shapes as the e2e bench."""
+    import jax
+
+    rng = np.random.default_rng(0)
+    host = [
+        rng.standard_normal((TOTAL_MB * (1 << 20) // 2 // 16 // 4096, 4096), np.float32)
+        for _ in range(16)
+    ]
+    dev = jax.devices()[0]
+    jax.block_until_ready(jax.device_put(host[0], dev))
+    t0 = time.perf_counter()
+    jax.block_until_ready([jax.device_put(h, dev) for h in host])
+    ours = time.perf_counter() - t0
+
+    with tempfile.NamedTemporaryFile(delete=False) as f:
+        path = f.name
+    try:
+        t0 = time.perf_counter()
+        with open(path, "wb") as f:
+            for h in host:
+                f.write(h.tobytes())
+        with open(path, "rb") as f:
+            blobs = [
+                np.frombuffer(f.read(h.nbytes), dtype=h.dtype).reshape(h.shape)
+                for h in host
+            ]
+        jax.block_until_ready([jax.device_put(b, dev) for b in blobs])
+        control = time.perf_counter() - t0
+    finally:
+        os.unlink(path)
+    mb = sum(h.nbytes for h in host) / 1e6
+    return {
+        # distinct metric name: a degraded run must not masquerade as e2e
+        "metric": "device_ingest_throughput_fallback",
+        "value": round(mb / ours, 2),
+        "unit": "MB/s/chip",
+        "vs_baseline": round(control / ours, 3),
+    }
+
+
+def _check_regression(out: dict) -> dict:
+    """Perf regression gate (VERDICT r2 #1): compare against the newest
+    recorded round. A drop >10% is flagged loudly on stderr and in the
+    JSON — a regressed number must never ship silently again."""
+    try:
+        prev_files = sorted(REPO.glob("BENCH_r*.json"))
+        if not prev_files:
+            return out
+        prev = json.loads(prev_files[-1].read_text()).get("parsed", {})
+        if prev.get("metric") != out["metric"]:
+            return out
+        out["vs_prev"] = round(out["value"] / prev["value"], 3)
+        if out["value"] < 0.9 * prev["value"]:
+            out["regressed"] = True
+            print(f"PERF REGRESSION: {out['value']} {out['unit']} < "
+                  f"last round's {prev['value']} ({prev_files[-1].name})",
+                  file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — the gate must not kill the bench
+        print(f"regression check skipped: {e}", file=sys.stderr)
+    return out
+
+
+def main():
+    try:
+        out = _bench_e2e()
+    except Exception as e:  # noqa: BLE001 — bench must always print a line
+        print(f"e2e bench failed ({type(e).__name__}: {e}); falling back",
+              file=sys.stderr)
+        out = _bench_fallback()
+    print(json.dumps(_check_regression(out)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""demodel-tpu: TPU-native caching/syncing/distributing middleware for
+models and datasets — capability rebuild of the reference MITM proxy
+(CA lifecycle + selective interception + content-addressed cache) with a
+TPU delivery stack on top (streamed HBM placement, peer DCN cache,
+Orbax-compatible network restore)."""
+
+__version__ = "0.3.0"

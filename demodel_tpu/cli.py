@@ -1,0 +1,269 @@
+"""CLI — parity with the reference's cobra surface (``cmd/demodel/main.go:56-81``):
+
+- ``demodel-tpu``            — bare invocation runs the server (ref ``main.go:68-70``)
+- ``demodel-tpu start``      — run the MITM caching proxy (ref ``start.go:218-230``)
+- ``demodel-tpu init``       — materialize the CA once (ref ``init.go:156-168``)
+- ``demodel-tpu export-ca``  — print CA PEM / inject into trust stores
+  (ref ``export_ca.go:22-120``), incl. the ``openssl`` preset the reference
+  README documents but never implemented (``README.md:50``, SURVEY.md §5)
+- ``demodel-tpu pull``       — north-star addition: pull a model through the
+  cache with ``--sink=tpu`` landing shards in HBM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from demodel_tpu import pki
+from demodel_tpu.config import ProxyConfig
+
+
+def _cmd_init(cfg: ProxyConfig, args) -> int:
+    ca = pki.read_or_new_ca(cfg.data_dir, use_ecdsa=cfg.use_ecdsa)
+    cert_path, _ = pki.ca_paths(cfg.data_dir)
+    print(f"CA ready at {cert_path}", file=sys.stderr)
+    assert ca.cert_pem
+    install_system_trust(cert_path.read_bytes())
+    return 0
+
+
+def install_system_trust(pem: bytes) -> bool:
+    """Install the CA into the OS trust store so clients using system roots
+    (curl, git-lfs, …) trust the proxy without per-tool flags.
+
+    The reference attempts this via ``smallstep/truststore``
+    (``init.go:145-148``) — with a pwd-relative-filename bug that makes the
+    first run fail (SURVEY.md §5); we implement the intended behavior:
+    Debian-style ``/usr/local/share/ca-certificates`` + a best-effort
+    ``update-ca-certificates``, failure-as-warning, never fatal.
+    ``DEMODEL_TRUST_DIR`` overrides the target (tests, non-root installs).
+    """
+    import os
+
+    trust_dir = Path(os.environ.get(
+        "DEMODEL_TRUST_DIR", "/usr/local/share/ca-certificates"))
+    target = trust_dir / "demodel-tpu-ca.crt"
+    try:
+        trust_dir.mkdir(parents=True, exist_ok=True)
+        target.write_bytes(pem)
+    except OSError as e:
+        print(f"trust-store: cannot write {target} ({e}); "
+              "run as root or use `export-ca`", file=sys.stderr)
+        return False
+    try:
+        subprocess.run(["update-ca-certificates"], capture_output=True,
+                       text=True, check=True, timeout=60)
+        print(f"trust-store: installed {target} (system bundle updated)",
+              file=sys.stderr)
+        return True
+    except FileNotFoundError:
+        print(f"trust-store: wrote {target}; update-ca-certificates not "
+              "found — refresh the bundle with your distro's tool",
+              file=sys.stderr)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        print(f"trust-store: wrote {target}; bundle refresh failed: {e}",
+              file=sys.stderr)
+    return False
+
+
+def _cmd_export_ca(cfg: ProxyConfig, args) -> int:
+    cert_path, _ = pki.ca_paths(cfg.data_dir)
+    if not cert_path.exists():
+        print("CA not initialized; run `demodel-tpu init` first", file=sys.stderr)
+        return 1
+    pem = cert_path.read_bytes()
+    if not args.for_:
+        sys.stdout.write(pem.decode())
+        return 0
+    for preset in args.for_:
+        if preset == "python-ssl":
+            _export_python_ssl(pem)
+        elif preset == "python-certifi":
+            _export_python_certifi(pem)
+        elif preset == "openssl":
+            _export_openssl(pem)
+        else:
+            print(f"unknown --for preset: {preset}", file=sys.stderr)
+            return 1
+    return 0
+
+
+def _export_python_ssl(pem: bytes) -> None:
+    """Write the CA into ssl's default capath (ref ``export_ca.go:51-86``,
+    which shells out to python; we *are* python, so query ssl directly)."""
+    import ssl
+
+    paths = ssl.get_default_verify_paths()
+    capath = paths.capath or (Path(paths.cafile).parent if paths.cafile else None)
+    if capath is None:
+        print("python-ssl: no capath/cafile reported by ssl", file=sys.stderr)
+        return
+    target = Path(capath) / "demodel-ca.crt"
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_bytes(pem)
+    print(f"python-ssl: wrote {target}", file=sys.stderr)
+
+
+def _export_python_certifi(pem: bytes) -> None:
+    """Append the CA to certifi's bundle (ref ``export_ca.go:87-103``). Unlike
+    the reference we skip the append if already present (idempotent)."""
+    try:
+        import certifi
+    except ImportError:
+        print("python-certifi: certifi not installed", file=sys.stderr)
+        return
+    bundle = Path(certifi.where())
+    existing = bundle.read_bytes()
+    if pem.strip() in existing:
+        print(f"python-certifi: already present in {bundle}", file=sys.stderr)
+        return
+    with open(bundle, "ab") as f:
+        f.write(b"\n" + pem)
+    print(f"python-certifi: appended to {bundle}", file=sys.stderr)
+
+
+def _export_openssl(pem: bytes) -> None:
+    """The preset the reference documents but doesn't implement
+    (``README.md:50`` vs ``export_ca.go:104-105``): install into OPENSSLDIR
+    with a subject-hash symlink so `openssl verify`/libssl pick it up."""
+    try:
+        out = subprocess.run(
+            ["openssl", "version", "-d"], capture_output=True, text=True, check=True
+        ).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        print(f"openssl: cannot locate OPENSSLDIR: {e}", file=sys.stderr)
+        return
+    # OPENSSLDIR: "/usr/lib/ssl"
+    ssl_dir = out.split(":", 1)[1].strip().strip('"')
+    certs = Path(ssl_dir) / "certs"
+    target = certs / "demodel-ca.crt"
+    try:
+        certs.mkdir(parents=True, exist_ok=True)
+        target.write_bytes(pem)
+        h = subprocess.run(
+            ["openssl", "x509", "-subject_hash", "-noout"],
+            input=pem, capture_output=True, check=True,
+        ).stdout.decode().strip()
+        link = certs / f"{h}.0"
+        if not link.exists():
+            link.symlink_to(target.name)
+        print(f"openssl: installed {target} ({link.name})", file=sys.stderr)
+    except (OSError, subprocess.CalledProcessError) as e:
+        print(f"openssl: install failed (need root?): {e}", file=sys.stderr)
+
+
+def _cmd_start(cfg: ProxyConfig, args) -> int:
+    from demodel_tpu.proxy import ProxyServer
+
+    server = ProxyServer(cfg)
+    server.start()
+    print(
+        f"demodel-tpu proxy listening on {cfg.host}:{cfg.port} "
+        f"(mitm_all={cfg.mitm_all} no_mitm={cfg.no_mitm} hosts={cfg.mitm_hosts})",
+        file=sys.stderr,
+    )
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def _cmd_pull(cfg: ProxyConfig, args) -> int:
+    from demodel_tpu.delivery import pull
+
+    try:
+        report = pull(
+            args.model,
+            cfg,
+            source=args.source,
+            sink=args.sink,
+            revision=args.revision,
+            peers=args.peer or None,
+        )
+    except Exception as e:  # noqa: BLE001 — CLI boundary: no raw tracebacks
+        print(f"pull failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(report, indent=2, default=str))
+    return 0
+
+
+def _cmd_serve(cfg: ProxyConfig, args) -> int:
+    """Run the full node: MITM caching proxy (with native /peer endpoints)
+    plus the /restore API over the same store."""
+    from demodel_tpu.delivery import open_store
+    from demodel_tpu.proxy import ProxyServer
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+
+    proxy = ProxyServer(cfg)
+    proxy.start()
+    store = restore = None
+    try:
+        store = open_store(cfg)
+        registry = RestoreRegistry(store)
+        restore = RestoreServer(registry, host=cfg.host,
+                                port=args.restore_port, proxy=proxy)
+        restore.start()
+        print(
+            f"demodel-tpu node: proxy+peer on {cfg.host}:{proxy.port}, "
+            f"restore API + /metrics on {cfg.host}:{restore.port}",
+            file=sys.stderr,
+        )
+        proxy.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if restore is not None:
+            restore.stop()
+        proxy.stop()
+        if store is not None:
+            store.close()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="demodel-tpu",
+        description="Caching, syncing, distributing middleware for models and "
+        "datasets — TPU-native. Bare invocation starts the proxy.",
+    )
+    sub = p.add_subparsers(dest="cmd")
+    sub.add_parser("start", help="run the MITM caching proxy")
+    sub.add_parser("init", help="create the root CA")
+    e = sub.add_parser("export-ca", help="export/install the root CA")
+    e.add_argument("--for", dest="for_", action="append", default=[],
+                   choices=["python-ssl", "python-certifi", "openssl"],
+                   help="trust-store preset (repeatable)")
+    pl = sub.add_parser("pull", help="pull a model through the cache")
+    pl.add_argument("model")
+    pl.add_argument("--source", default="hf", choices=["hf", "ollama"])
+    pl.add_argument("--sink", default="cache", choices=["cache", "tpu"])
+    pl.add_argument("--revision", default="main")
+    pl.add_argument("--peer", action="append", default=[],
+                    help="peer node base URL tried before upstream (repeatable)")
+    sv = sub.add_parser("serve", help="run proxy + peer + restore APIs")
+    sv.add_argument("--restore-port", type=int, default=8081)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = ProxyConfig.from_env()
+    cmd = args.cmd or "start"  # bare root runs the server (main.go:68-70)
+    if cmd == "init":
+        return _cmd_init(cfg, args)
+    if cmd == "export-ca":
+        return _cmd_export_ca(cfg, args)
+    if cmd == "pull":
+        return _cmd_pull(cfg, args)
+    if cmd == "serve":
+        return _cmd_serve(cfg, args)
+    return _cmd_start(cfg, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

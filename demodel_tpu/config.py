@@ -1,0 +1,95 @@
+"""Configuration — reference env-var semantics, bugs fixed.
+
+The reference parses five ``DEMODEL_PROXY_*`` vars at package init
+(``cmd/demodel/main.go:23-42``) with a latent bug: with
+``DEMODEL_PROXY_MITM_HOSTS`` *unset*, ``strings.Split("", ",")`` yields
+``[""]`` which clobbers the default host list, so out of the box nothing is
+intercepted (SURVEY.md §5). This rebuild implements the *intended*
+semantics: defaults apply when the env is unset; set-but-empty clears.
+
+Paths follow XDG (successor of ``adrg/xdg`` / the legacy ``directories``
+crate): data (CA material) under ``$XDG_DATA_HOME/demodel-tpu``, cache
+(store root) under ``$XDG_CACHE_HOME/demodel-tpu``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from demodel_tpu.utils.env import env_bool, env_int
+
+#: reference default MITM target list (``main.go:38-42``)
+DEFAULT_MITM_HOSTS = ["huggingface.co:443"]
+
+
+def xdg_data_home() -> Path:
+    return Path(os.environ.get("XDG_DATA_HOME",
+                               Path.home() / ".local" / "share"))
+
+
+def xdg_cache_home() -> Path:
+    return Path(os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache"))
+
+
+def default_data_dir() -> Path:
+    return xdg_data_home() / "demodel-tpu"
+
+
+def default_cache_dir() -> Path:
+    return xdg_cache_home() / "demodel-tpu"
+
+
+@dataclass
+class ProxyConfig:
+    host: str = "0.0.0.0"
+    port: int = 8080  # reference listens on :8080 (``start.go:206``)
+    mitm_all: bool = False
+    no_mitm: bool = False
+    mitm_hosts: list[str] = field(
+        default_factory=lambda: list(DEFAULT_MITM_HOSTS))
+    use_ecdsa: bool = False  # reference default is RSA (sic, 4095-bit)
+    cache_enabled: bool = True
+    data_dir: Path = field(default_factory=default_data_dir)
+    cache_dir: Path = field(default_factory=default_cache_dir)
+    #: extra CA bundle for verifying UPSTREAM servers (tests, corp proxies)
+    upstream_ca: str | None = None
+
+    def __post_init__(self):
+        self.data_dir = Path(self.data_dir)
+        self.cache_dir = Path(self.cache_dir)
+
+    def should_mitm(self, authority: str) -> bool:
+        """Connect-policy parity with ``start.go:183-196`` — ``no_mitm``
+        wins, then ``mitm_all``, then the exact ``host:port`` list."""
+        if self.no_mitm:
+            return False
+        if self.mitm_all:
+            return True
+        return authority in self.mitm_hosts
+
+    @classmethod
+    def from_env(cls) -> "ProxyConfig":
+        cfg = cls(
+            host=os.environ.get("DEMODEL_PROXY_HOST", "0.0.0.0"),
+            port=env_int("DEMODEL_PROXY_PORT", 8080),
+            mitm_all=env_bool("DEMODEL_PROXY_MITM_ALL"),
+            no_mitm=env_bool("DEMODEL_PROXY_NO_MITM"),
+            use_ecdsa=env_bool("DEMODEL_PROXY_CA_USE_ECDSA"),
+        )
+        # intended semantics: unset → defaults survive; set → replace
+        # (empty string clears); EXTRA_HOSTS always extends
+        hosts_env = os.environ.get("DEMODEL_PROXY_MITM_HOSTS")
+        if hosts_env is not None:
+            cfg.mitm_hosts = [h.strip() for h in hosts_env.split(",")
+                              if h.strip()]
+        extra = os.environ.get("DEMODEL_PROXY_MITM_EXTRA_HOSTS", "")
+        cfg.mitm_hosts += [h.strip() for h in extra.split(",") if h.strip()]
+        if "DEMODEL_DATA_DIR" in os.environ:
+            cfg.data_dir = Path(os.environ["DEMODEL_DATA_DIR"])
+        if "DEMODEL_CACHE_DIR" in os.environ:
+            cfg.cache_dir = Path(os.environ["DEMODEL_CACHE_DIR"])
+        if "DEMODEL_UPSTREAM_CA" in os.environ:
+            cfg.upstream_ca = os.environ["DEMODEL_UPSTREAM_CA"]
+        return cfg

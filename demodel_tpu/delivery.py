@@ -1,0 +1,265 @@
+"""Pull orchestration: registry → store → (snapshot dir | TPU HBM).
+
+This is the north-star delivery layer (``BASELINE.json`` ``north_star``): the
+reference stops at cached bytes on disk; the rebuild can additionally land a
+pulled checkpoint directly in device memory under a ``NamedSharding``
+(``sink="tpu"``, see :mod:`demodel_tpu.sink`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+from demodel_tpu.config import ProxyConfig
+from demodel_tpu.registry.base import PullReport
+from demodel_tpu.store import Store
+from demodel_tpu.utils import metrics
+from demodel_tpu.utils.logging import get_logger
+
+log = get_logger("delivery")
+
+
+def open_store(cfg: ProxyConfig) -> Store:
+    """The delivery client and the MITM proxy share one store root, so a
+    model pulled by either path is a cache hit for the other."""
+    return Store(cfg.cache_dir / "proxy")
+
+
+def manifest_key(source: str, model: str) -> str:
+    """Store key of a pulled model's manifest record (lets any node —
+    including a peer that syncs the record — re-materialize or serve the
+    model without re-walking the registry)."""
+    from demodel_tpu.store import key_for_uri
+
+    return key_for_uri(f"demodel://models/{source}/{model}")
+
+
+def pull(
+    model: str,
+    cfg: ProxyConfig,
+    source: str = "hf",
+    sink: str = "cache",
+    revision: str = "main",
+    endpoint: str | None = None,
+    store: Store | None = None,
+    mesh=None,
+    peers: list[str] | None = None,
+) -> dict:
+    """Pull ``model`` and deliver to the requested sink.
+
+    sink="cache" → bytes live in the content-addressed store;
+    sink="tpu"   → additionally stream tensors into device HBM under a
+                   NamedSharding and report placement.
+    ``peers`` — base URLs of peer nodes tried (over DCN) before upstream.
+    """
+    report, _ = pull_to_hbm(
+        model, cfg, source=source, revision=revision, endpoint=endpoint,
+        store=store, mesh=mesh, peers=peers, deliver=(sink == "tpu"),
+    )
+    return report
+
+
+def _persist_manifest(store: Store, mkey: str, out: dict,
+                      failed_keys: set[str]) -> None:
+    """Write the model-manifest record, omitting files whose cache commit
+    failed (a durable manifest must never reference keys that aren't in the
+    store — they would break later serve/materialize/peer-restore)."""
+    rec = out
+    if failed_keys:
+        rec = dict(out)
+        rec["files"] = [f for f in out["files"] if f["key"] not in failed_keys]
+        log.warning("manifest omits %d files whose cache commit failed",
+                    len(out["files"]) - len(rec["files"]))
+    if store.has(mkey):
+        store.remove(mkey)
+    store.put(mkey, json.dumps(rec).encode(),
+              {"kind": "model-manifest", "model": rec["name"],
+               "source": rec["source"]})
+
+
+def pull_to_hbm(
+    model: str,
+    cfg: ProxyConfig,
+    source: str = "hf",
+    revision: str = "main",
+    endpoint: str | None = None,
+    store: Store | None = None,
+    mesh=None,
+    peers: list[str] | None = None,
+    deliver: bool = True,
+    defer_cache_commit: bool = False,
+):
+    """Pull ``model`` and stream its weights into HBM as shards arrive.
+
+    Fetch workers overlap with device landing (:mod:`demodel_tpu.sink.streaming`)
+    so the wall-clock is max(network, host→device), not the sum. Returns
+    ``(report_dict, Placement | None)`` — the placement holds the live
+    sharded arrays.
+
+    ``defer_cache_commit=True`` returns as soon as the placement is resident
+    (the north-star clock): pending cache commits, the manifest record, and
+    the store close (when owned) move to a background finalizer — join it
+    via ``placement.finalize()`` before reading the store or exiting.
+    """
+    own_store = store is None
+    if store is None:
+        store = open_store(cfg)
+    elif defer_cache_commit:
+        # the background finalizer would run cache commits against a store
+        # handle the caller could close first — a native use-after-free.
+        # Deferred persistence is only safe when this function owns the
+        # store lifecycle.
+        raise ValueError("defer_cache_commit=True requires pull_to_hbm to "
+                         "own the store (omit the store= argument)")
+    peer_set = None
+    if peers is None:
+        peers = [p for p in os.environ.get("DEMODEL_PEERS", "").split(",") if p.strip()]
+    if peers:
+        from demodel_tpu.parallel.peer import PeerSet
+
+        peer_set = PeerSet(peers)
+    sink_worker = None
+    handed_off = False  # True once the background finalizer owns flush+close
+    t0 = time.perf_counter()
+    try:
+        if deliver:
+            from demodel_tpu.sink.streaming import StreamingSink
+
+            sink_worker = StreamingSink(store, mesh=mesh)
+
+        if sink_worker is not None:
+            _sink = sink_worker
+
+            def on_file(artifact):
+                _sink.submit(artifact)
+                # the sink queue (and the background cache commit) hold their
+                # own buffer references; dropping this one keeps peak host
+                # RAM at the in-flight window, not the whole model
+                artifact.buffer = None
+        else:
+            on_file = None
+
+        # memory-first delivery only when a sink consumes the buffers: peer
+        # bytes land in host memory → HBM, the cache copy commits on a
+        # background thread (disk never gates the cold-pull→HBM clock)
+        memory_sink = deliver and peer_set is not None
+        if source == "hf":
+            from demodel_tpu.registry.hf import HFRegistry
+
+            reg = HFRegistry(
+                store,
+                endpoint=endpoint or os.environ.get("HF_ENDPOINT", "https://huggingface.co"),
+                token=os.environ.get("HF_TOKEN"),
+                ca=cfg.upstream_ca,
+                peers=peer_set,
+                memory_sink=memory_sink,
+            )
+            report = reg.pull(model, revision=revision, on_file=on_file)
+        elif source == "ollama":
+            from demodel_tpu.registry.ollama import OllamaRegistry
+
+            reg = OllamaRegistry(
+                store,
+                endpoint=endpoint or os.environ.get("OLLAMA_REGISTRY", "https://registry.ollama.ai"),
+                ca=cfg.upstream_ca,
+                peers=peer_set,
+                memory_sink=memory_sink,
+            )
+            report = reg.pull(model, on_file=on_file)
+        else:
+            raise ValueError(f"unknown source {source!r}")
+
+        out = report.to_dict()
+        mkey = manifest_key(source, model)
+        metrics.HUB.inc("pulls_total")
+        metrics.HUB.inc("pull_bytes_total", report.total_bytes)
+        metrics.HUB.inc("pull_files_from_peer_total",
+                        sum(1 for f in report.files if f.from_peer))
+        metrics.HUB.inc("pull_files_from_cache_total",
+                        sum(1 for f in report.files if f.from_cache))
+        placed = None
+        if sink_worker is not None:
+            placed = sink_worker.finish()
+            sink_worker = None
+            sink_secs = time.perf_counter() - t0
+            out["tpu_sink"] = {
+                "tensors": len(placed.arrays),
+                "bytes": placed.total_bytes,
+                "secs": round(sink_secs, 3),
+                "mesh": str(placed.mesh_desc),
+            }
+            metrics.HUB.inc("sink_tensors_total", len(placed.arrays))
+            metrics.HUB.inc("sink_bytes_total", placed.total_bytes)
+            metrics.HUB.inc("sink_secs_total", sink_secs)
+        if defer_cache_commit and placed is not None:
+            # the north-star clock stops here — disk persistence (cache
+            # commits + manifest) and the store close continue off it
+            fetcher, close_store = reg.fetcher, own_store
+
+            def _finalize():
+                try:
+                    fails = fetcher.flush_writes()
+                    placed.commit_errors = fails
+                    placed.integrity_errors = list(fetcher.integrity_failures)
+                    _persist_manifest(store, mkey, out,
+                                      {k for k, _ in fails})
+                except BaseException as e:  # noqa: BLE001 — surfaced at finalize()
+                    placed.finalize_error = e
+                finally:
+                    if close_store:
+                        store.close()
+
+            import threading
+
+            t = threading.Thread(target=_finalize, daemon=True,
+                                 name="delivery-finalize")
+            t.start()
+            placed.finalizer = t
+            handed_off = True
+        else:
+            # manifest only after every cache commit landed: a durable
+            # record must not reference keys that never hit the store
+            fails = reg.fetcher.flush_writes()
+            _persist_manifest(store, mkey, out, {k for k, _ in fails})
+            if reg.fetcher.integrity_failures:
+                # optimistic verify found the delivered bytes corrupt —
+                # the placement is poisoned; fail the pull
+                raise IOError(
+                    "peer bytes failed digest verification after delivery: "
+                    f"{reg.fetcher.integrity_failures}")
+        return out, placed
+    finally:
+        if sink_worker is not None:  # pull raised — abandon delivery
+            sink_worker.cancel()
+        if not handed_off:
+            # in-flight cache commits hold native pointers into the store —
+            # closing it under them would be a use-after-free, so join them
+            # before any close
+            if "reg" in locals():
+                reg.fetcher.flush_writes()
+            if own_store:
+                store.close()
+
+
+def materialize(report: PullReport | dict, store: Store, dest: Path) -> list[Path]:
+    """Write a pulled snapshot out of the store into ``dest`` with original
+    filenames — what a foreign tool (``transformers.from_pretrained``)
+    expects on disk."""
+    if isinstance(report, PullReport):
+        files = [(f.name, f.key) for f in report.files]
+    else:
+        files = [(f["name"], f["key"]) for f in report["files"]]
+    dest = Path(dest)
+    dest.mkdir(parents=True, exist_ok=True)
+    out = []
+    for name, key in files:
+        safe = name.replace(":", "_").replace("/", "_")
+        path = dest / safe
+        with open(path, "wb") as f:
+            for chunk in store.stream(key):
+                f.write(chunk)
+        out.append(path)
+    return out

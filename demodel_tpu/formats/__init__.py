@@ -1,0 +1,3 @@
+from demodel_tpu.formats import gguf, safetensors
+
+__all__ = ["gguf", "safetensors"]

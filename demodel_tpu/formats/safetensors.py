@@ -1,0 +1,174 @@
+"""safetensors parsing/serialization, range-read oriented.
+
+The HBM sink never loads whole checkpoint files: it reads the 8-byte length
+prefix + JSON header, then issues per-tensor (per-shard) byte-range reads.
+This module owns the header math; it is wire-compatible with the upstream
+``safetensors`` wheel (parity-tested in tests/test_formats.py).
+
+Format: ``u64le header_len | header JSON | data``; each tensor entry is
+``{"dtype": TAG, "shape": [...], "data_offsets": [start, end]}`` with
+offsets relative to the data section.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+try:  # bf16 & friends — present in this environment (jax dependency)
+    import ml_dtypes
+except ImportError:  # pragma: no cover
+    ml_dtypes = None
+
+#: safetensors dtype tag → numpy dtype
+_DTYPES: dict[str, np.dtype] = {
+    "F64": np.dtype(np.float64),
+    "F32": np.dtype(np.float32),
+    "F16": np.dtype(np.float16),
+    "I64": np.dtype(np.int64),
+    "I32": np.dtype(np.int32),
+    "I16": np.dtype(np.int16),
+    "I8": np.dtype(np.int8),
+    "U8": np.dtype(np.uint8),
+    "BOOL": np.dtype(np.bool_),
+    "U16": np.dtype(np.uint16),
+    "U32": np.dtype(np.uint32),
+    "U64": np.dtype(np.uint64),
+}
+if ml_dtypes is not None:
+    _DTYPES["BF16"] = np.dtype(ml_dtypes.bfloat16)
+    _DTYPES["F8_E4M3"] = np.dtype(ml_dtypes.float8_e4m3fn)
+    _DTYPES["F8_E5M2"] = np.dtype(ml_dtypes.float8_e5m2)
+
+_TAGS = {v: k for k, v in _DTYPES.items()}
+
+MAX_HEADER = 100 << 20  # defensive: a 100MB header is not a checkpoint
+
+
+def _np_dtype(tag: str) -> np.dtype:
+    try:
+        return _DTYPES[tag]
+    except KeyError:
+        raise ValueError(f"unsupported safetensors dtype {tag!r}") from None
+
+
+def _tag_for(dtype: np.dtype) -> str:
+    try:
+        return _TAGS[np.dtype(dtype)]
+    except KeyError:
+        raise ValueError(f"unsupported numpy dtype {dtype!r}") from None
+
+
+@dataclass(frozen=True)
+class TensorSpec:
+    name: str
+    dtype: str                 # safetensors tag
+    shape: tuple[int, ...]
+    start: int                 # ABSOLUTE offset of first data byte
+    end: int                   # absolute end (exclusive)
+
+    @property
+    def nbytes(self) -> int:
+        return self.end - self.start
+
+    def to_numpy(self, raw: bytes | memoryview) -> np.ndarray:
+        dt = _np_dtype(self.dtype)
+        if len(raw) != self.nbytes:
+            raise ValueError(
+                f"{self.name}: got {len(raw)} bytes, want {self.nbytes}")
+        return np.frombuffer(raw, dtype=dt).reshape(self.shape)
+
+
+@dataclass(frozen=True)
+class Index:
+    tensors: dict[str, TensorSpec]
+    metadata: dict
+    data_start: int            # absolute offset where the data section begins
+    total_size: int | None     # file size when known (validation)
+
+
+def _parse_header_json(hdr: bytes, data_start: int,
+                       total_size: int | None) -> Index:
+    try:
+        obj = json.loads(hdr.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ValueError(f"safetensors header is not valid JSON: {e}") from None
+    if not isinstance(obj, dict):
+        raise ValueError("safetensors header must be a JSON object")
+    metadata = obj.pop("__metadata__", {}) or {}
+    tensors: dict[str, TensorSpec] = {}
+    data_len = None if total_size is None else total_size - data_start
+    for name, info in obj.items():
+        if not isinstance(info, dict):
+            raise ValueError(f"{name}: bad tensor entry")
+        try:
+            tag = info["dtype"]
+            shape = tuple(int(d) for d in info["shape"])
+            s, e = info["data_offsets"]
+        except (KeyError, TypeError, ValueError):
+            raise ValueError(f"{name}: malformed tensor entry") from None
+        dt = _np_dtype(tag)
+        want = int(np.prod(shape, dtype=np.int64)) * dt.itemsize if shape \
+            else dt.itemsize
+        if e - s != want:
+            raise ValueError(
+                f"{name}: data_offsets span {e - s} != dtype×shape {want}")
+        if s < 0 or e < s or (data_len is not None and e > data_len):
+            raise ValueError(f"{name}: data_offsets [{s},{e}) out of bounds")
+        tensors[name] = TensorSpec(name=name, dtype=tag, shape=shape,
+                                   start=data_start + s, end=data_start + e)
+    return Index(tensors=tensors, metadata=metadata, data_start=data_start,
+                 total_size=total_size)
+
+
+def parse_header(blob: bytes | memoryview) -> Index:
+    """Parse the header of an in-memory safetensors file."""
+    if len(blob) < 8:
+        raise ValueError("truncated safetensors file (no length prefix)")
+    (n,) = struct.unpack("<Q", bytes(blob[:8]))
+    if n > MAX_HEADER or 8 + n > len(blob):
+        raise ValueError(f"safetensors header length {n} out of bounds")
+    return _parse_header_json(bytes(blob[8:8 + n]), 8 + n, len(blob))
+
+
+def read_index_from(read_at, total_size: int | None = None) -> Index:
+    """Parse a header through a range-reader ``read_at(offset, length)`` —
+    the store/HTTP path, no whole-file load."""
+    prefix = bytes(read_at(0, 8))
+    if len(prefix) < 8:
+        raise ValueError("truncated safetensors file (no length prefix)")
+    (n,) = struct.unpack("<Q", prefix)
+    if n > MAX_HEADER or (total_size is not None and 8 + n > total_size):
+        raise ValueError(f"safetensors header length {n} out of bounds")
+    hdr = bytes(read_at(8, n))
+    if len(hdr) != n:
+        raise ValueError("truncated safetensors header")
+    return _parse_header_json(hdr, 8 + n, total_size)
+
+
+def serialize(tensors: dict[str, np.ndarray],
+              metadata: dict | None = None) -> bytes:
+    """Write a safetensors blob (sorted offsets, upstream-compatible)."""
+    header: dict = {}
+    if metadata:
+        header["__metadata__"] = {str(k): str(v) for k, v in metadata.items()}
+    bodies: list[bytes] = []
+    off = 0
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        header[name] = {
+            "dtype": _tag_for(arr.dtype),
+            "shape": list(arr.shape),
+            "data_offsets": [off, off + len(raw)],
+        }
+        bodies.append(raw)
+        off += len(raw)
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    # upstream pads the header with spaces to 8-byte alignment
+    pad = (8 - (len(hdr) % 8)) % 8
+    hdr += b" " * pad
+    return struct.pack("<Q", len(hdr)) + hdr + b"".join(bodies)

@@ -1,0 +1,107 @@
+"""Loader for the C++ data plane (``native/libdemodel_native.so``).
+
+Builds on first use (``make -C native``) so a fresh checkout needs no
+separate build step, then configures every ctypes prototype once — the
+defaults (int restype) silently truncate 64-bit handles and offsets.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+from demodel_tpu.utils.logging import get_logger
+
+log = get_logger("native")
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_SO = _NATIVE_DIR / "build" / "libdemodel_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def _needs_build() -> bool:
+    if not _SO.exists():
+        return True
+    so_mtime = _SO.stat().st_mtime
+    for src in _NATIVE_DIR.glob("*.cc"):
+        if src.stat().st_mtime > so_mtime:
+            return True
+    for hdr in _NATIVE_DIR.glob("*.h"):
+        if hdr.stat().st_mtime > so_mtime:
+            return True
+    return False
+
+
+def build() -> None:
+    """(Re)build the shared library via make."""
+    log.info("building native data plane (make -C %s)", _NATIVE_DIR)
+    proc = subprocess.run(
+        ["make", "-C", str(_NATIVE_DIR)],
+        capture_output=True, text=True, timeout=600,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed:\n{proc.stdout}\n{proc.stderr}")
+
+
+def _configure(L: ctypes.CDLL) -> None:
+    c = ctypes
+    P, I, I64, CP = c.c_void_p, c.c_int, c.c_int64, c.c_char_p
+
+    def sig(name, restype, argtypes):
+        fn = getattr(L, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+
+    # store lifecycle + queries
+    sig("dm_store_open", P, [CP, CP, I])
+    sig("dm_store_close", None, [P])
+    sig("dm_store_has", I, [P, CP])
+    sig("dm_store_size", I64, [P, CP])
+    sig("dm_store_partial_size", I64, [P, CP])
+    sig("dm_store_meta", I, [P, CP, CP, I])
+    sig("dm_store_pread", I64, [P, CP, P, I64, I64])
+    sig("dm_store_put", I, [P, CP, P, I64, CP, CP])
+    sig("dm_store_remove", I, [P, CP])
+    sig("dm_store_has_digest", I, [P, CP])
+    sig("dm_store_materialize", I, [P, CP, CP, CP])
+    sig("dm_store_begin", P, [P, CP, I, CP, I])
+    sig("dm_store_begin_ranged", P, [P, CP, I64, CP, I])
+    sig("dm_store_index_json", I, [P, CP, I])
+    sig("dm_store_list", I, [P, CP, I])
+    sig("dm_key_for_uri", None, [CP, CP])
+    # streaming writer
+    sig("dm_writer_append", I, [P, P, I64])
+    sig("dm_writer_offset", I64, [P])
+    sig("dm_writer_digest", None, [P, CP])
+    sig("dm_writer_commit", I, [P, CP])
+    sig("dm_writer_abort", None, [P, I])
+    # parallel range writer
+    sig("dm_rw_pwrite", I, [P, P, I64, I64])
+    sig("dm_rw_written", I64, [P])
+    sig("dm_rw_commit", I, [P, CP, CP, CP])
+    sig("dm_rw_abort", None, [P, I])
+    # peer fetch (data plane in proxy.cc)
+    sig("dm_peer_fetch", I64, [P, CP, I, CP, CP, CP, CP, CP, I])
+    sig("dm_peer_fetch_parallel", I64,
+        [P, CP, I, CP, CP, I64, I, CP, CP, CP, I])
+    sig("dm_peer_fetch_into", I64, [CP, I, CP, I64, I, CP, P, CP, I])
+    # proxy prototypes are configured in demodel_tpu.proxy (its call sites)
+
+
+def lib() -> ctypes.CDLL:
+    """The loaded (building if needed) native library, prototypes set."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _needs_build():
+            build()
+        L = ctypes.CDLL(str(_SO))
+        _configure(L)
+        _lib = L
+        return L

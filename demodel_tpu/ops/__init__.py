@@ -1,0 +1,4 @@
+from demodel_tpu.ops import dequant
+from demodel_tpu.ops.ring_attention import ring_attention
+
+__all__ = ["dequant", "ring_attention"]

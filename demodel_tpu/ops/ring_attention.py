@@ -1,0 +1,130 @@
+"""Ring attention: exact context-parallel attention over an ``sp`` mesh axis.
+
+Long-context delivery-side parallelism (SURVEY.md §5 "Long-context /
+sequence parallelism"): the sequence is sharded over ``sp``; K/V chunks
+rotate around the ring via ``lax.ppermute`` while each device keeps a
+numerically-stable online-softmax accumulator (flash-attention style), so
+attention is EXACT — identical to dense up to float error — with activation
+memory O(T/n) per device and N-1 ICI hops instead of an all-gather.
+
+Supports causal masking (global positions derived from the ring index),
+grouped-query attention (fewer K/V heads than Q heads), and sequences that
+do not divide the ring size (internal padding, masked out of the softmax).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+NEG_INF = -1e30  # large-but-finite: -inf rows would NaN through exp/where
+
+
+def dense_attention(q, k, v, causal: bool = True,
+                    scale: float | None = None) -> jax.Array:
+    """Reference single-device attention. q: [B,T,H,D], k/v: [B,T,Hkv,D]."""
+    B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    if H != Hkv:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if scale is None:
+        scale = D ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                   scale: float | None = None,
+                   kv_len: jax.Array | None = None) -> jax.Array:
+    """Per-shard ring attention (call inside shard_map over ``axis_name``).
+
+    q: [B, T_loc, H, D]; k/v: [B, T_loc, Hkv, D] (GQA repeats on the fly).
+    ``kv_len`` (global) masks ring padding when the true sequence length is
+    not a multiple of the ring size.
+    """
+    B, Tq, H, D = q.shape
+    Hkv = k.shape[2]
+    if H != Hkv:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if scale is None:
+        scale = D ** -0.5
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    Tk = k.shape[1]
+
+    q32 = q.astype(jnp.float32) * scale
+    num = jnp.zeros((B, H, Tq, D), jnp.float32)
+    den = jnp.zeros((B, H, Tq), jnp.float32)
+    m = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+
+    q_pos = my * Tq + jnp.arange(Tq)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        src = (my - step) % n
+        k_pos = src * Tk + jnp.arange(Tk)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k.astype(jnp.float32))
+        valid = jnp.ones((Tq, Tk), bool)
+        if causal:
+            valid &= q_pos[:, None] >= k_pos[None, :]
+        if kv_len is not None:
+            valid &= (k_pos < kv_len)[None, :]
+        scores = jnp.where(valid[None, None], scores, NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        # exp(NEG_INF - m_new) underflows to 0 — masked keys contribute
+        # nothing; no NaN path because NEG_INF is finite
+        num = num * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+        den = den * alpha + p.sum(axis=-1)
+        m = m_new
+        if step != n - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+
+    out = num / jnp.maximum(den, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "sp",
+                           causal: bool = True) -> jax.Array:
+    """Global-view wrapper: shards the sequence over ``axis`` (padding to a
+    multiple of the ring size, masked), runs the ring, unpads."""
+    n = int(mesh.shape[axis])
+    B, T, H, D = q.shape
+    pad = (-T) % n
+    kv_len = None
+    if pad:
+        kv_len = jnp.int32(T)
+        zq = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q = jnp.pad(q, zq)
+        k = jnp.pad(k, zq)
+        v = jnp.pad(v, zq)
+
+    spec = P(None, axis, None, None)
+    fn = _shard_map(
+        functools.partial(ring_attention, axis_name=axis, causal=causal,
+                          kv_len=kv_len),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    out = fn(q, k, v)
+    return out[:, :T] if pad else out

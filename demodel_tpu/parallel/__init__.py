@@ -1,0 +1,4 @@
+from demodel_tpu.parallel.mesh import make_mesh
+from demodel_tpu.parallel.peer import PeerSet, ensure_artifacts
+
+__all__ = ["make_mesh", "PeerSet", "ensure_artifacts"]

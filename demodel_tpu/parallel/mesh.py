@@ -1,0 +1,59 @@
+"""Device mesh construction — one helper, every parallelism axis.
+
+Axes (jax.sharding.Mesh names): ``dp`` data, ``sp`` sequence/context
+(ring attention), ``ep`` expert, ``pp`` pipeline, ``tp`` tensor. ``dp`` and
+``tp`` always exist (size 1 when unused) so ``NamedSharding`` specs written
+against them stay valid on any mesh; the optional axes appear only when
+requested. The leftover device factor lands in ``tp`` unless ``tp`` was
+pinned, in which case it lands in ``dp`` — e.g. ``make_mesh(8)`` →
+``{'dp': 1, 'tp': 8}``; ``make_mesh(8, tp=1, pp=4)`` → ``{'dp': 2,
+'pp': 4, 'tp': 1}``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None, *, dp: int | None = None,
+              sp: int | None = None, ep: int | None = None,
+              pp: int | None = None, tp: int | None = None) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    n = len(devices)
+
+    fixed = 1
+    for v in (dp, sp, ep, pp, tp):
+        if v is not None:
+            if v <= 0:
+                raise ValueError("mesh axis sizes must be positive")
+            fixed *= v
+    if n % fixed != 0:
+        raise ValueError(f"{n} devices not divisible by requested axes "
+                         f"(product {fixed})")
+    rest = n // fixed
+    if tp is None:
+        tp = rest
+        rest = 1
+    if dp is None:
+        dp = rest
+        rest = 1
+    if rest != 1:
+        raise ValueError(f"axis sizes {fixed * rest} != device count {n}")
+
+    names, sizes = ["dp"], [dp]
+    for name, size in (("sp", sp), ("ep", ep), ("pp", pp)):
+        if size is not None:
+            names.append(name)
+            sizes.append(size)
+    names.append("tp")
+    sizes.append(tp)
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))

@@ -1,0 +1,254 @@
+"""CA lifecycle and per-host leaf-certificate minting.
+
+Re-implements the reference PKI semantics (``cmd/demodel/init.go:26-154`` for
+the root CA, ``cmd/demodel/start.go:27-165`` for leaf minting) on top of
+``cryptography`` — this is control-plane work (once per install / once per
+first-seen host), so Python is the right altitude; the C++ data plane only
+*loads* the PEM files this module writes.
+
+Reference semantics kept:
+- load-or-create self-signed root CA; RSA (ref used 4095 bits — an off-by-one
+  we fix to 4096) or ECDSA-P256 under ``DEMODEL_PROXY_CA_USE_ECDSA``
+  (``init.go:66-70``);
+- SubjectKeyId = SHA1 of the SPKI (``init.go:79-92``);
+- validity 2 years 3 months, the mkcert convention (``init.go:94-99``);
+- ``CA:TRUE`` with MaxPathLen 0 (``init.go:111-115``);
+- PEM files in the XDG data dir as ``certificates/demodel-ca.{crt,pem}``
+  with 0644/0600 modes (``init.go:32-38,135-143``);
+- leaf certs: signed by the CA, serverAuth+clientAuth EKU, DNS SAN =
+  hostname, same 2y3m validity (``start.go:71-87``), cached in-memory
+  under a lock (``start.go:37-38,118-120`` — we close its benign TOCTOU
+  with a double-check under the write lock);
+- 128-bit random serial numbers (``main.go:49-54``).
+
+Reference bug NOT reproduced (SURVEY.md §5): the ref attempts a trust-store
+install of a pwd-relative file it never wrote (``init.go:145``) and panics the
+first run; we install from the real written path and treat failure as a warning.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import secrets
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, rsa
+from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+CA_CERT_NAME = "demodel-ca.crt"
+CA_KEY_NAME = "demodel-ca.pem"
+
+
+def _write_private(path: Path, data: bytes) -> None:
+    """Create key files 0600 atomically (no world-readable write→chmod window;
+    the reference passes the mode to os.WriteFile, ``init.go:139-143``)."""
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+
+#: mkcert-convention validity (reference ``init.go:94-99``).
+VALIDITY = (2, 3)  # years, months
+
+_ORG = "demodel-tpu development CA"
+
+
+def _not_after(now: datetime.datetime) -> datetime.datetime:
+    years, months = VALIDITY
+    month = now.month + months
+    year = now.year + years + (month - 1) // 12
+    month = (month - 1) % 12 + 1
+    day = min(now.day, 28)
+    return now.replace(year=year, month=month, day=day)
+
+
+def _new_key(use_ecdsa: bool):
+    if use_ecdsa:
+        return ec.generate_private_key(ec.SECP256R1())
+    # Leafs don't need 4096 bits and minting cost is the per-host hot step
+    # (the ref pays full-size keygen per first-seen host, ``start.go:51-55``);
+    # the CA stays 4096.
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _serial() -> int:
+    # 128-bit random serial (reference ``main.go:49-54``).
+    return secrets.randbits(128)
+
+
+@dataclass
+class CA:
+    cert: x509.Certificate
+    key: object  # rsa or ec private key
+    cert_pem: bytes
+    key_pem: bytes
+
+
+def ca_paths(data_dir: Path) -> tuple[Path, Path]:
+    d = data_dir / "certificates"
+    return d / CA_CERT_NAME, d / CA_KEY_NAME
+
+
+def read_or_new_ca(data_dir: Path, use_ecdsa: bool = False) -> CA:
+    """Load the root CA from ``data_dir`` or create+persist it.
+
+    Mirrors ``readOrNewCA`` (``init.go:31-154``): files-exist early return,
+    else keygen → self-sign → write PEMs (0644 cert / 0600 key).
+    """
+    cert_path, key_path = ca_paths(data_dir)
+    if cert_path.exists() and key_path.exists():
+        cert_pem = cert_path.read_bytes()
+        key_pem = key_path.read_bytes()
+        cert = x509.load_pem_x509_certificate(cert_pem)
+        key = serialization.load_pem_private_key(key_pem, password=None)
+        return CA(cert, key, cert_pem, key_pem)
+
+    key = ec.generate_private_key(ec.SECP256R1()) if use_ecdsa else rsa.generate_private_key(
+        public_exponent=65537, key_size=4096
+    )
+    now = datetime.datetime.now(datetime.timezone.utc) - datetime.timedelta(hours=1)
+    # Per-instance unique CN (mkcert does the same with user@host): OpenSSL
+    # resolves issuers BY SUBJECT, so two independent demodel CAs with an
+    # identical DN would collide during chain building whenever both are
+    # visible to one verifier (e.g. one installed in the OS trust store and
+    # another presented in a handshake) — the wrong-keyed candidate can make
+    # verification fail outright.
+    import secrets
+
+    name = x509.Name(
+        [
+            x509.NameAttribute(
+                NameOID.COMMON_NAME, f"demodel-tpu CA {secrets.token_hex(4)}"),
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, _ORG),
+        ]
+    )
+    ski = x509.SubjectKeyIdentifier.from_public_key(key.public_key())
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(_serial())
+        .not_valid_before(now)
+        .not_valid_after(_not_after(now))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0), critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=False,
+                content_commitment=False,
+                key_encipherment=False,
+                data_encipherment=False,
+                key_agreement=False,
+                key_cert_sign=True,
+                crl_sign=True,
+                encipher_only=False,
+                decipher_only=False,
+            ),
+            critical=True,
+        )
+        .add_extension(ski, critical=False)
+    )
+    cert = builder.sign(key, hashes.SHA256())
+
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    cert_path.parent.mkdir(parents=True, exist_ok=True)
+    cert_path.write_bytes(cert_pem)
+    os.chmod(cert_path, 0o644)
+    _write_private(key_path, key_pem)
+    return CA(cert, key, cert_pem, key_pem)
+
+
+class LeafMinter:
+    """Per-host leaf certificate minting with an in-memory cache.
+
+    The reference's ``CertStorage`` (``start.go:27-165``): first ``fetch``
+    for a hostname mints a leaf signed by the root CA and caches it. We mint
+    to PEM *files* (under ``work_dir``) because the C++ data plane consumes
+    cert/key paths via ``SSL_CTX_use_certificate_chain_file``.
+    """
+
+    def __init__(self, ca: CA, work_dir: Path, use_ecdsa: bool = False):
+        self.ca = ca
+        self.work_dir = Path(work_dir)
+        self.use_ecdsa = use_ecdsa
+        self._lock = threading.Lock()
+        self._cache: dict[str, tuple[str, str]] = {}
+
+    def fetch(self, hostname: str) -> tuple[str, str]:
+        """Return ``(cert_path, key_path)`` for ``hostname``, minting once.
+
+        Unlike the ref (``start.go:118-120``) the mint happens under the
+        lock, so two threads cannot mint the same host concurrently.
+        """
+        with self._lock:
+            hit = self._cache.get(hostname)
+            if hit is not None:
+                return hit
+            paths = self._mint(hostname)
+            self._cache[hostname] = paths
+            return paths
+
+    def _mint(self, hostname: str) -> tuple[str, str]:
+        key = _new_key(self.use_ecdsa)
+        now = datetime.datetime.now(datetime.timezone.utc) - datetime.timedelta(hours=1)
+        san: list[x509.GeneralName]
+        try:
+            san = [x509.IPAddress(ipaddress.ip_address(hostname))]
+        except ValueError:
+            san = [x509.DNSName(hostname)]
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(
+                x509.Name(
+                    [
+                        x509.NameAttribute(NameOID.COMMON_NAME, hostname),
+                        x509.NameAttribute(NameOID.ORGANIZATION_NAME, _ORG),
+                    ]
+                )
+            )
+            .issuer_name(self.ca.cert.subject)
+            .public_key(key.public_key())
+            .serial_number(_serial())
+            .not_valid_before(now)
+            .not_valid_after(_not_after(now))
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+            .add_extension(x509.SubjectAlternativeName(san), critical=False)
+            .add_extension(
+                x509.ExtendedKeyUsage(
+                    [ExtendedKeyUsageOID.SERVER_AUTH, ExtendedKeyUsageOID.CLIENT_AUTH]
+                ),
+                critical=False,
+            )
+        )
+        cert = builder.sign(self.ca.key, hashes.SHA256())
+
+        d = self.work_dir / "leafs"
+        d.mkdir(parents=True, exist_ok=True)
+        safe = hostname.replace(":", "_").replace("/", "_")
+        cert_path = d / f"{safe}.crt"
+        key_path = d / f"{safe}.key"
+        # Chain file: leaf + CA so clients can build the path.
+        cert_path.write_bytes(
+            cert.public_bytes(serialization.Encoding.PEM) + self.ca.cert_pem
+        )
+        _write_private(
+            key_path,
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            ),
+        )
+        return str(cert_path), str(key_path)

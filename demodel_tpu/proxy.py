@@ -1,0 +1,139 @@
+"""Python control plane for the C++ MITM caching proxy.
+
+Wires the native data plane (``native/proxy.cc``) to the Python-side PKI:
+the C++ proxy calls back into :class:`~demodel_tpu.pki.LeafMinter` the first
+time it sees a host, then caches the SSL_CTX natively. Mirrors the reference
+``start()`` wiring (``cmd/demodel/start.go:167-216``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import threading
+
+from demodel_tpu import native, pki
+from demodel_tpu.config import ProxyConfig
+from demodel_tpu.utils.env import env_int
+
+_MINT_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,                 # host
+    ctypes.POINTER(ctypes.c_char),   # cert path out
+    ctypes.POINTER(ctypes.c_char),   # key path out
+    ctypes.c_int,                    # buffer cap
+)
+
+
+class ProxyServer:
+    """Owns a native proxy instance plus the CA/minter that feed it."""
+
+    def __init__(
+        self,
+        cfg: ProxyConfig,
+        upstream_ca: str | None = None,
+        verbose: bool = True,
+        io_timeout_sec: int = 75,
+        max_body_mb: int = 64,
+    ):
+        self.cfg = cfg
+        if upstream_ca is None:
+            upstream_ca = cfg.upstream_ca
+        self._lib = native.lib()
+        self._setup_sigs()
+        self.ca = pki.read_or_new_ca(cfg.data_dir, use_ecdsa=cfg.use_ecdsa)
+        self._minter = pki.LeafMinter(self.ca, cfg.data_dir, use_ecdsa=cfg.use_ecdsa)
+        self._stop_evt = threading.Event()
+
+        def _mint(host: bytes, cert_out, key_out, cap: int) -> int:
+            try:
+                cert, key = self._minter.fetch(host.decode())
+                cb = cert.encode() + b"\0"
+                kb = key.encode() + b"\0"
+                if len(cb) > cap or len(kb) > cap:
+                    return -1
+                ctypes.memmove(cert_out, cb, len(cb))
+                ctypes.memmove(key_out, kb, len(kb))
+                return 0
+            except Exception:  # noqa: BLE001 — crossing the C boundary
+                return -1
+
+        # keep a reference: the native side holds this pointer for its lifetime
+        self._mint_cb = _MINT_CB(_mint)
+
+        store_root = str(cfg.cache_dir / "proxy") if cfg.cache_enabled else ""
+        self._h = self._lib.dm_proxy_new(
+            cfg.host.encode(),
+            cfg.port,
+            1 if cfg.mitm_all else 0,
+            1 if cfg.no_mitm else 0,
+            ",".join(cfg.mitm_hosts).encode(),
+            store_root.encode(),
+            (upstream_ca or "").encode(),
+            1 if cfg.cache_enabled else 0,
+            ctypes.cast(self._mint_cb, ctypes.c_void_p),
+            1 if verbose else 0,
+            io_timeout_sec,
+            env_int("DEMODEL_MAX_BODY_MB", max_body_mb),
+        )
+        if not self._h:
+            raise OSError("proxy allocation failed")
+
+    def _setup_sigs(self) -> None:
+        c = ctypes
+        L = self._lib
+        if getattr(L, "_proxy_sigs_done", False):
+            return
+        L.dm_proxy_new.argtypes = [
+            c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_char_p,
+            c.c_char_p, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_int64,
+        ]
+        L.dm_proxy_new.restype = c.c_void_p
+        L.dm_proxy_start.argtypes = [c.c_void_p]
+        L.dm_proxy_start.restype = c.c_int
+        L.dm_proxy_port.argtypes = [c.c_void_p]
+        L.dm_proxy_port.restype = c.c_int
+        L.dm_proxy_stop.argtypes = [c.c_void_p]
+        L.dm_proxy_stop.restype = None
+        L.dm_proxy_free.argtypes = [c.c_void_p]
+        L.dm_proxy_free.restype = None
+        L.dm_proxy_metrics.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+        L.dm_proxy_metrics.restype = c.c_int
+        L._proxy_sigs_done = True
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ProxyServer":
+        rc = self._lib.dm_proxy_start(self._h)
+        if rc != 0:
+            raise OSError(-rc, "proxy start failed")
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._lib.dm_proxy_port(self._h)
+
+    @property
+    def url(self) -> str:
+        host = "127.0.0.1" if self.cfg.host in ("0.0.0.0", "") else self.cfg.host
+        return f"http://{host}:{self.port}"
+
+    def metrics(self) -> dict:
+        buf = ctypes.create_string_buffer(1024)
+        self._lib.dm_proxy_metrics(self._h, buf, 1024)
+        return json.loads(buf.value.decode())
+
+    def wait(self) -> None:
+        self._stop_evt.wait()
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.dm_proxy_stop(self._h)
+            self._lib.dm_proxy_free(self._h)
+            self._h = None
+        self._stop_evt.set()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,6 @@
+from demodel_tpu.registry.base import Fetcher, FileArtifact, PullReport
+from demodel_tpu.registry.hf import HFRegistry
+from demodel_tpu.registry.ollama import OllamaRegistry
+
+__all__ = ["Fetcher", "FileArtifact", "PullReport", "HFRegistry",
+           "OllamaRegistry"]

@@ -1,0 +1,454 @@
+"""Shared streaming fetch machinery for registry adapters.
+
+The reference has no native pull client — it relies on foreign clients
+(huggingface-cli, Ollama, …) pulling *through* the proxy (``README.md:14-21``).
+The rebuild keeps that interception path (see ``demodel_tpu.proxy``) and adds
+this first-party client so ``demodel-tpu pull`` can populate the same
+content-addressed store directly and feed the TPU sink, with chunk-level
+resume the reference never had (SURVEY.md §5 "Checkpoint / resume").
+"""
+
+from __future__ import annotations
+
+
+import errno
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import requests
+
+from demodel_tpu.store import Store, key_for_uri
+from demodel_tpu.utils.env import env_int
+from demodel_tpu.utils.logging import get_logger
+
+log = get_logger("registry")
+
+CHUNK = 1 << 20
+
+
+@dataclass
+class FileArtifact:
+    name: str
+    uri: str            # canonical (pre-redirect) URI — store key derives from it
+    key: str
+    size: int
+    sha256: str
+    media_type: str = ""
+    etag: str = ""
+    from_cache: bool = False
+    from_peer: bool = False
+    resumed_from: int = 0
+    secs: float = 0.0
+    #: host landing buffer (memory-first peer fetch) — consumed by the HBM
+    #: sink; never serialized into reports
+    buffer: object = None
+
+
+@dataclass
+class PullReport:
+    source: str
+    name: str
+    revision: str
+    files: list[FileArtifact] = field(default_factory=list)
+    secs: float = 0.0
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(f.size for f in self.files)
+
+    def to_dict(self) -> dict:
+        return {
+            "source": self.source,
+            "name": self.name,
+            "revision": self.revision,
+            "total_bytes": self.total_bytes,
+            "secs": round(self.secs, 3),
+            "files": [{k: v for k, v in vars(f).items() if k != "buffer"}
+                      for f in self.files],
+        }
+
+
+class Fetcher:
+    """requests-based streaming downloader writing through the Store.
+
+    Sessions are per-thread so registry adapters can fetch shards
+    concurrently (``requests.Session`` is not thread-safe)."""
+
+    def __init__(self, store: Store, ca: str | None = None,
+                 proxies: dict | None = None, headers: dict | None = None,
+                 peers=None, memory_sink: bool = False):
+        self.store = store
+        # per-request verify (not Session.verify): a REQUESTS_CA_BUNDLE /
+        # CURL_CA_BUNDLE env var silently overrides the session attribute
+        self.verify = ca if ca else True
+        self.peers = peers  # Optional[demodel_tpu.parallel.peer.PeerSet]
+        #: memory-first delivery: peer bytes land in a host buffer handed
+        #: straight to the HBM sink; the cache copy commits off the
+        #: delivery critical path (join via flush_writes)
+        self.memory_sink = memory_sink
+        self._proxies = dict(proxies or {})
+        self._headers = dict(headers or {})
+        self._tls = threading.local()
+        self._commit_lock = threading.Lock()
+        self._commit_pool: ThreadPoolExecutor | None = None
+        self._commit_futs: list = []
+        self._deferred_commits: list[tuple] = []
+        #: bytes of landing buffers held by pending/in-flight commits
+        #: (incremented at submit, released as each commit completes)
+        self._backlog_bytes = 0
+        #: ``[(key, "ExcType: msg")]`` for cache commits that failed —
+        #: populated by the commit workers, returned by :meth:`flush_writes`
+        #: so callers can drop those keys from durable manifests
+        self.commit_failures: list[tuple[str, str]] = []
+        #: subset of :attr:`commit_failures` where the re-hash found the
+        #: delivered bytes CORRUPT (EBADMSG) — callers must treat the
+        #: placement built from those buffers as poisoned
+        self.integrity_failures: list[tuple[str, str]] = []
+
+    @property
+    def session(self) -> requests.Session:
+        s = getattr(self._tls, "session", None)
+        if s is None:
+            s = requests.Session()
+            s.proxies.update(self._proxies)
+            s.headers.update(self._headers)
+            self._tls.session = s
+        return s
+
+    def get_json(self, url: str) -> dict:
+        r = self.session.get(url, timeout=60, verify=self.verify)
+        r.raise_for_status()
+        return r.json()
+
+    @staticmethod
+    def _mode_env(var: str, truthy: tuple, falsy: tuple) -> bool | None:
+        """Parse a mode knob; boolean spellings accepted, unrecognized
+        non-empty values warn and yield None (degrade-not-crash, matching
+        ``utils/env.py``'s contract)."""
+        env = os.environ.get(var, "").strip().lower()
+        if not env:
+            return None
+        if env in truthy or env in ("1", "true", "yes", "on"):
+            return True
+        if env in falsy or env in ("0", "false", "no", "off"):
+            return False
+        log.warning("%s=%r not recognized (want %s/%s); using default",
+                    var, env, truthy[0], falsy[0])
+        return None
+
+    @staticmethod
+    def _verify_eager() -> bool:
+        """Whether memory-first peer bytes are sha256-verified inline
+        (before delivery) or optimistically at the background cache commit.
+        Default couples to :meth:`_commit_eager`: with spare cores the
+        inline hash overlaps the transfer and fails early; on a starved
+        host it would serialize with the transfer, so verification rides
+        the commit and surfaces via ``Placement.finalize``."""
+        mode = Fetcher._mode_env("DEMODEL_PEER_VERIFY",
+                                 ("eager", "inline"),
+                                 ("commit", "lazy", "deferred"))
+        return mode if mode is not None else Fetcher._commit_eager()
+
+    @staticmethod
+    def _commit_eager() -> bool:
+        """Whether cache commits overlap the pull (spare cores) or defer to
+        ``flush_writes`` (a starved host must not let disk writes + digest
+        re-verification contend with fetch and device dispatch — measured
+        as the bulk of the r02 bench regression on a 1-core host)."""
+        mode = Fetcher._mode_env("DEMODEL_CACHE_COMMIT",
+                                 ("eager", "overlap"),
+                                 ("deferred", "lazy"))
+        return mode if mode is not None else (os.cpu_count() or 1) >= 4
+
+    @staticmethod
+    def _commit_backlog_budget() -> int:
+        """Bytes of landing buffers the pending-commit backlog may pin
+        (``DEMODEL_COMMIT_BACKLOG_MB``). Pending commits hold a reference to
+        the full file buffer; without a bound, a 15-shard 70B pull would pin
+        the whole checkpoint in host RAM regardless of the sink's own
+        budget."""
+        return env_int("DEMODEL_COMMIT_BACKLOG_MB", 2048, minimum=1) << 20
+
+    def _commit_buffer_async(self, key: str, buf, peer_meta: dict,
+                             digest: str) -> None:
+        """Persist a landing buffer into the store off the critical path
+        (deferred to ``flush_writes`` on starved hosts, a 2-worker pool
+        otherwise). If the backlog would pin more than the byte budget, the
+        calling fetch worker drains the oldest job inline — fetch throttles
+        to disk instead of accumulating unbounded RAM."""
+        job = (key, buf, dict(peer_meta), digest)
+        budget = self._commit_backlog_budget()
+        if not self._commit_eager():
+            drain = []
+            with self._commit_lock:
+                self._deferred_commits.append(job)
+                self._backlog_bytes += len(buf)
+                projected = self._backlog_bytes
+                while projected > budget and len(self._deferred_commits) > 1:
+                    oldest = self._deferred_commits.pop(0)
+                    projected -= len(oldest[1])
+                    drain.append(oldest)
+            for j in drain:  # _commit_one releases each job's bytes
+                self._commit_one(j)
+            return
+        with self._commit_lock:
+            if self._commit_pool is None:
+                # a small shared pool: N uncapped threads would pin N full
+                # landing buffers and thrash the disk (ADVICE r2)
+                self._commit_pool = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="cache-commit")
+            self._backlog_bytes += len(buf)
+            self._commit_futs.append(
+                self._commit_pool.submit(self._commit_one, job))
+        while True:
+            # disk lagging the network: block this fetch worker on the
+            # oldest LIVE commit until the backlog fits the budget, so
+            # queued futures can't pin the whole model (done futures have
+            # already released their bytes — prune, don't wait on them)
+            with self._commit_lock:
+                self._commit_futs = [f for f in self._commit_futs
+                                     if not f.done()]
+                over = self._backlog_bytes > budget
+                oldest_fut = self._commit_futs[0] if self._commit_futs else None
+            if not over or oldest_fut is None:
+                return
+            oldest_fut.result()
+
+    def _commit_one(self, job: tuple) -> None:
+        key, buf, peer_meta, digest = job
+        try:
+            try:
+                w = self.store.begin_ranged(key, len(buf))
+                try:
+                    w.pwrite(buf, 0)
+                    w.commit(peer_meta, expected_digest=digest or None)
+                except BaseException:
+                    w.abort()
+                    raise
+            except OSError as e:
+                if e.errno != errno.EBADMSG and digest:
+                    # the commit died BEFORE its re-hash could verify the
+                    # delivered bytes (e.g. ENOSPC) — under optimistic
+                    # verification that hash is the only integrity check, so
+                    # run it directly on the buffer before reporting a
+                    # plain cache failure
+                    import hashlib
+
+                    got = hashlib.sha256(buf).hexdigest()
+                    if got != digest:
+                        raise OSError(
+                            errno.EBADMSG,
+                            f"delivered bytes hash {got}, expected {digest} "
+                            f"(commit also failed: {e})") from e
+                raise
+        except BaseException as e:  # noqa: BLE001 — recorded, never escapes
+            # cache write failure must not fail the delivery — the bytes
+            # are already on device; the store just stays cold for this key.
+            # EBADMSG is different: the re-hash proved the DELIVERED bytes
+            # corrupt (optimistic verify) — record it so flush_writes /
+            # finalize can poison the placement.
+            entry = (key, f"{type(e).__name__}: {e}")
+            with self._commit_lock:
+                self.commit_failures.append(entry)
+                if isinstance(e, OSError) and e.errno == errno.EBADMSG:
+                    self.integrity_failures.append(entry)
+            log.warning("background cache commit of %s failed: %s", key, e)
+        finally:
+            with self._commit_lock:
+                self._backlog_bytes -= len(buf)
+
+    def flush_writes(self, timeout: float | None = None) -> list[tuple[str, str]]:
+        """Run deferred commits and join in-flight ones (store fully
+        populated on return). Returns ``[(key, error)]`` for commits that
+        failed — callers persisting manifests should omit those keys.
+
+        On ``timeout`` the un-joined futures stay queued (a later flush can
+        still join them — required before the store may be closed)."""
+        with self._commit_lock:
+            deferred, self._deferred_commits = self._deferred_commits, []
+            futs = list(self._commit_futs)
+        for job in deferred:
+            self._commit_one(job)
+        joined = []
+        try:
+            for f in futs:
+                f.result(timeout)
+                joined.append(f)
+        finally:
+            with self._commit_lock:
+                self._commit_futs = [f for f in self._commit_futs
+                                     if f not in joined]
+        with self._commit_lock:
+            return list(self.commit_failures)
+
+    def probe_lfs_digest(self, url: str) -> str | None:
+        """HEAD ``url`` (no redirect follow) and return the LFS blob sha256
+        from ``X-Linked-Etag`` when present (the HF Hub convention for
+        ``/resolve`` of an LFS file). One cheap round-trip that enables
+        content-address dedup before any bytes move."""
+        try:
+            r = self.session.head(url, timeout=30, allow_redirects=False,
+                                  verify=self.verify)
+        except requests.RequestException:
+            return None
+        etag = (r.headers.get("X-Linked-Etag") or "").strip('"')
+        if len(etag) == 64 and all(c in "0123456789abcdef" for c in etag):
+            return etag
+        return None
+
+    def fetch(
+        self,
+        url: str,
+        name: str,
+        expected_digest: str | None = None,
+        media_type: str = "",
+        extra_headers: dict | None = None,
+    ) -> FileArtifact:
+        """Stream ``url`` into the store under its URI key.
+
+        - cache hit → served locally, zero network;
+        - partial present → resumed with a Range request (falls back to a
+          full restart when the server ignores the range);
+        - ``expected_digest`` (hex sha256) verified against the streamed
+          bytes; mismatch removes the entry and raises.
+        """
+        key = key_for_uri(url)
+        t0 = time.perf_counter()
+        from_peer = False
+        if (not self.store.has(key) and expected_digest
+                and self.store.has_digest(expected_digest)):
+            # content-address hit: the same bytes are already local under a
+            # different cache key (e.g. the MITM proxy cached them under the
+            # post-redirect CDN URL) — publish a hardlink, zero transfer
+            try:
+                self.store.materialize(key, expected_digest, {
+                    "uri": url, "name": name, "sha256": expected_digest,
+                    "media_type": media_type,
+                })
+                log.info("dedup %s: materialized from local digest %s", name,
+                         expected_digest[:12])
+            except OSError as e:
+                # benign race: the last key holding that digest was removed
+                # between has_digest and link — fall through to peer/upstream
+                log.debug("dedup %s failed (%s); fetching normally", name, e)
+        if (not self.store.has(key) and self.peers is not None
+                and self.memory_sink):
+            got = self.peers.fetch_to_memory(key, expected_digest=expected_digest,
+                                             eager_verify=self._verify_eager())
+            if got is not None:
+                buf, peer_meta = got
+                digest = expected_digest or peer_meta.get("sha256", "")
+                self._commit_buffer_async(key, buf, peer_meta, digest)
+                log.info("fetched %s: %d bytes from peer into memory in %.2fs",
+                         name, len(buf), time.perf_counter() - t0)
+                return FileArtifact(
+                    name=name, uri=url, key=key, size=len(buf), sha256=digest,
+                    media_type=media_type, etag=peer_meta.get("etag", ""),
+                    from_peer=True, secs=time.perf_counter() - t0, buffer=buf,
+                )
+        if not self.store.has(key) and self.peers is not None:
+            # DCN-first: a pod peer that already holds the bytes beats the
+            # upstream registry (README.md:5-10 made first-class)
+            from_peer = self.peers.fetch_into(self.store, key,
+                                              expected_digest=expected_digest)
+        meta = self.store.meta(key) if self.store.has(key) else None
+        if meta is not None:
+            if expected_digest and meta.get("sha256") != expected_digest:
+                log.warning("cached %s digest mismatch; refetching", name)
+                self.store.remove(key)
+            else:
+                return FileArtifact(
+                    name=name, uri=url, key=key, size=meta.get("size", self.store.size(key)),
+                    sha256=meta.get("sha256", ""), media_type=media_type,
+                    etag=meta.get("etag", ""), from_cache=not from_peer,
+                    from_peer=from_peer, secs=time.perf_counter() - t0,
+                )
+
+        resumed_from = 0
+        partial = self.store.partial_size(key)
+        headers = dict(extra_headers or {})
+        if partial > 0:
+            headers["Range"] = f"bytes={partial}-"
+
+        r = self.session.get(url, headers=headers, stream=True, timeout=300,
+                             allow_redirects=True, verify=self.verify)
+        if partial > 0 and r.status_code == 416:
+            # partial covers the whole object (e.g. crash between last byte
+            # and commit) — the range is unsatisfiable; restart clean
+            r.close()
+            r = self.session.get(url, stream=True, timeout=300,
+                                 allow_redirects=True, verify=self.verify)
+            partial = 0
+        try:
+            if partial > 0 and r.status_code == 206:
+                w = self.store.begin(key, resume=True)
+                resumed_from = partial
+            else:
+                r.raise_for_status()
+                w = self.store.begin(key, resume=False)
+            try:
+                for chunk in r.iter_content(CHUNK):
+                    if chunk:
+                        w.append(chunk)
+                digest = w.digest()
+                if expected_digest and digest != expected_digest:
+                    w.abort(keep_partial=False)
+                    raise IOError(
+                        f"digest mismatch for {name}: got {digest}, want {expected_digest}"
+                    )
+                etag = (r.headers.get("ETag") or "").strip('"')
+                size = w.offset
+                w.commit(
+                    {
+                        "uri": url,
+                        "name": name,
+                        "size": size,
+                        "sha256": digest,
+                        "etag": etag,
+                        "media_type": media_type,
+                        "final_url": r.url,
+                        "headers": {
+                            "content-type": r.headers.get("Content-Type", ""),
+                            "content-encoding": r.headers.get("Content-Encoding", ""),
+                        },
+                    }
+                )
+            except BaseException:
+                # keep bytes for resume on transport errors; digest mismatch
+                # already dropped them above
+                if w._open:  # noqa: SLF001 — writer state check
+                    w.abort(keep_partial=True)
+                raise
+        finally:
+            r.close()
+        dt = time.perf_counter() - t0
+        log.info("fetched %s: %d bytes in %.2fs (resumed_from=%d)", name, size, dt,
+                 resumed_from)
+        return FileArtifact(
+            name=name, uri=url, key=key, size=size, sha256=digest,
+            media_type=media_type, etag=etag, resumed_from=resumed_from, secs=dt,
+        )
+
+
+def fetch_workers() -> int:
+    """Concurrent shard fetches per pull (``DEMODEL_FETCH_WORKERS``).
+
+    The reference's clients pull shards one at a time through the proxy;
+    first-party pulls overlap transfers so a multi-shard checkpoint saturates
+    the link (and a warm peer's serving threads) instead of round-tripping
+    per file."""
+    return env_int("DEMODEL_FETCH_WORKERS", 8, minimum=1)
+
+
+def parallel_fetch(jobs: list, fn) -> list:
+    """Run ``fn(job)`` over a thread pool, preserving job order.
+
+    Any failure cancels nothing already in flight (their partials stay
+    resumable) but re-raises the first error after all workers settle."""
+    if len(jobs) <= 1 or fetch_workers() == 1:
+        return [fn(j) for j in jobs]
+    with ThreadPoolExecutor(max_workers=min(fetch_workers(), len(jobs))) as ex:
+        return list(ex.map(fn, jobs))

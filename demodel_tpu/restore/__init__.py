@@ -1,0 +1,4 @@
+from demodel_tpu.restore.client import restore
+from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+
+__all__ = ["restore", "RestoreRegistry", "RestoreServer"]

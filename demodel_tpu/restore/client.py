@@ -1,0 +1,80 @@
+"""Restore client: ``/restore`` endpoint → sharded device arrays.
+
+The consumer half of the north-star restore path: a serving stack
+(JetStream/MaxText-style) points at a demodel-tpu node instead of GCS and
+restores a checkpoint straight into HBM under its own shardings. Each
+device's shard is fetched as an HTTP **Range** of the tensor's bytes — on a
+multi-host mesh every host pulls only its addressable slice, so restore
+bandwidth scales with hosts.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import requests
+from jax.sharding import Mesh
+
+from demodel_tpu.formats.safetensors import _np_dtype
+from demodel_tpu.parallel.mesh import make_mesh
+from demodel_tpu.sink.hbm import Placement, place_tensor
+from demodel_tpu.sink.plan import ShardingPlan
+from demodel_tpu.utils.logging import get_logger
+
+log = get_logger("restore.client")
+
+
+@dataclass
+class RestoreResult(Placement):
+    secs: float = 0.0
+    bytes_fetched: int = 0
+    manifest: dict = field(default_factory=dict)
+
+
+def restore(
+    endpoint: str,
+    model: str,
+    mesh: Mesh | None = None,
+    plan: ShardingPlan | None = None,
+    cast_to=None,
+    session: requests.Session | None = None,
+    timeout: float = 300.0,
+) -> RestoreResult:
+    """Restore ``model`` from a demodel-tpu ``/restore`` endpoint."""
+    if mesh is None:
+        mesh = make_mesh()
+    if plan is None:
+        plan = ShardingPlan(mesh)
+    s = session or requests.Session()
+    endpoint = endpoint.rstrip("/")
+    t0 = time.perf_counter()
+
+    r = s.get(f"{endpoint}/restore/{model}/manifest", timeout=timeout)
+    r.raise_for_status()
+    manifest = r.json()
+
+    out = RestoreResult(mesh_desc=f"{dict(mesh.shape)}", manifest=manifest)
+    fetched = 0
+    for name, info in manifest["tensors"].items():
+        shape = tuple(info["shape"])
+        np_dtype = _np_dtype(info["dtype"])
+        sharding = plan.sharding_for(name, shape, np_dtype.itemsize)
+        url = f"{endpoint}/restore/{model}/tensor/{name}"
+
+        def read_at(off, ln, url=url):
+            nonlocal fetched
+            rr = s.get(url, headers={"Range": f"bytes={off}-{off + ln - 1}"},
+                       timeout=timeout)
+            rr.raise_for_status()
+            fetched += len(rr.content)
+            return rr.content
+
+        out.arrays[name] = place_tensor(
+            read_at, shape, np_dtype, 0, sharding, cast_to
+        )
+    out.secs = time.perf_counter() - t0
+    out.bytes_fetched = fetched
+    log.info("restored %s: %d tensors, %.1f MB fetched in %.2fs",
+             model, len(out.arrays), fetched / 1e6, out.secs)
+    return out

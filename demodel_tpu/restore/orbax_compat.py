@@ -1,0 +1,78 @@
+"""Orbax interop: delivered Placements ↔ Orbax checkpoints.
+
+Closes the loop with the wider JAX ecosystem: a model pulled through the
+proxy and landed in HBM can be written as a standard Orbax checkpoint (for
+tools that insist on GCS/disk checkpoints), and an existing Orbax checkpoint
+can be loaded back under delivery shardings. This — not a reimplementation
+of TensorStore — is the pragmatic "Orbax-compatible" surface: the HTTP
+restore path (:mod:`demodel_tpu.restore`) for demodel-tpu-aware consumers,
+and real Orbax files for everyone else.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+
+from demodel_tpu.sink.hbm import Placement
+from demodel_tpu.utils.logging import get_logger
+
+log = get_logger("restore.orbax")
+
+
+def _nest(flat: dict[str, jax.Array]) -> dict:
+    """'a.b.c' keys → nested dict (Orbax trees are nested)."""
+    tree: dict = {}
+    for name, arr in flat.items():
+        node = tree
+        parts = name.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return tree
+
+
+def _flatten(tree: dict, prefix: str = "") -> dict:
+    flat = {}
+    for k, v in tree.items():
+        name = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            flat.update(_flatten(v, name))
+        else:
+            flat[name] = v
+    return flat
+
+
+def save_placement(placement: Placement, path: Path | str) -> None:
+    """Write a delivered Placement as a standard Orbax checkpoint."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, _nest(placement.arrays))
+    log.info("saved %d tensors to orbax checkpoint %s", len(placement.arrays), path)
+
+
+def load_placement(path: Path | str, shardings: dict | None = None) -> Placement:
+    """Load an Orbax checkpoint back into a Placement (optionally resharded
+    with ``shardings``: flat name → NamedSharding)."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        if shardings:
+            meta = ckptr.metadata(path)
+            flat_meta = _flatten(meta)
+            restore_tree = {}
+            for name, m in flat_meta.items():
+                sh = shardings.get(name)
+                restore_tree[name] = ocp.utils.to_shape_dtype_struct(m, sharding=sh) \
+                    if sh is not None else m
+            tree = ckptr.restore(path, _nest(restore_tree))
+        else:
+            tree = ckptr.restore(path)
+    flat = _flatten(tree)
+    out = Placement(arrays=flat, mesh_desc="orbax")
+    log.info("loaded %d tensors from orbax checkpoint %s", len(flat), path)
+    return out

@@ -1,0 +1,298 @@
+"""Restore API server — the successor of the legacy Rust generation's axum
+control/serving surface (``Cargo.lock:458-474``, SURVEY.md §2.2) and the
+north star's "Orbax-compatible ``/restore`` endpoint that JetStream/MaxText
+hit instead of GCS" (``BASELINE.json``).
+
+Serves checkpoint-shaped HTTP over the content-addressed store:
+
+- ``GET /restore/models``                    → registered model names
+- ``GET /restore/{model}/manifest``          → pytree skeleton: every tensor's
+  dtype/shape/nbytes (+ which stored blob holds it)
+- ``GET /restore/{model}/tensor/{name}``     → that tensor's raw bytes,
+  **Range-aware** so a restoring host fetches exactly its shards' byte
+  ranges — the property that makes sharded multi-host restore bandwidth-
+  optimal (each byte crosses DCN once).
+
+Tensor-name addressing (rather than file addressing) is what Orbax-style
+restores need; actual Orbax checkpoint interop lives in
+:mod:`demodel_tpu.restore.orbax_compat`.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from demodel_tpu.formats import safetensors as st
+from demodel_tpu.store import Store
+from demodel_tpu.utils import metrics
+from demodel_tpu.utils.logging import get_logger
+
+log = get_logger("restore")
+
+
+@dataclass(frozen=True)
+class _TensorLoc:
+    key: str      # store key of the safetensors blob
+    dtype: str    # safetensors dtype tag
+    shape: tuple[int, ...]
+    start: int    # absolute offset within the blob
+    nbytes: int
+
+
+class RestoreRegistry:
+    """model name → tensor locations, built from stored safetensors blobs."""
+
+    def __init__(self, store: Store):
+        self.store = store
+        self._models: dict[str, dict[str, _TensorLoc]] = {}
+        self._lock = threading.Lock()
+
+    def register_safetensors(self, model: str, keys: list[str]) -> int:
+        if not keys:
+            raise ValueError(f"model {model}: no safetensors blobs to register")
+        tensors: dict[str, _TensorLoc] = {}
+        for key in keys:
+            index = st.read_index_from(
+                lambda off, ln, k=key: self.store.pread(k, ln, off)
+            )
+            for name, spec in index.tensors.items():
+                if name in tensors:
+                    raise ValueError(f"duplicate tensor {name} in model {model}")
+                tensors[name] = _TensorLoc(
+                    key=key, dtype=spec.dtype, shape=spec.shape,
+                    start=spec.start, nbytes=spec.nbytes,
+                )
+        with self._lock:
+            self._models[model] = tensors
+        log.info("registered model %s: %d tensors", model, len(tensors))
+        return len(tensors)
+
+    def register_report(self, model: str, report) -> int:
+        files = report.files if hasattr(report, "files") else report["files"]
+        keys = [
+            (f.key if hasattr(f, "key") else f["key"])
+            for f in files
+            if (f.name if hasattr(f, "name") else f["name"]).endswith(".safetensors")
+        ]
+        return self.register_safetensors(model, keys)
+
+    def models(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def put_safetensors(self, model: str, src, length: int) -> int:
+        """Commit a pushed safetensors blob (``src``: readable stream of
+        ``length`` bytes) into the store and register it for restore — the
+        server half of the network-Orbax *save* path. Returns the tensor
+        count. A re-push replaces the previous registration."""
+        from demodel_tpu.store import key_for_uri
+
+        key = key_for_uri(f"demodel://restore/{model}/pushed")
+        if self.store.has(key):
+            self.store.remove(key)
+        w = self.store.begin(key)
+        try:
+            remaining = length
+            while remaining > 0:
+                chunk = src.read(min(1 << 20, remaining))
+                if not chunk:
+                    raise ValueError(f"body truncated at {length - remaining}"
+                                     f"/{length} bytes")
+                w.append(chunk)
+                remaining -= len(chunk)
+            w.commit({"kind": "pushed-checkpoint", "model": model,
+                      "size": length})
+        except BaseException:
+            if w._open:  # noqa: SLF001 — writer state check
+                w.abort(keep_partial=False)
+            raise
+        try:
+            return self.register_safetensors(model, [key])
+        except Exception:
+            # an unparsable blob must not stay registered or cached
+            self.store.remove(key)
+            raise
+
+    def _lazy_resolve(self, model: str) -> bool:
+        """Register ``model`` from a pull-manifest record in the store
+        (written by :func:`demodel_tpu.delivery.pull`), if one exists."""
+        import json as _json
+
+        from demodel_tpu.delivery import manifest_key
+
+        for source in ("hf", "ollama"):
+            mkey = manifest_key(source, model)
+            if not self.store.has(mkey):
+                continue
+            try:
+                record = _json.loads(self.store.get(mkey).decode())
+                self.register_report(model, record)
+                return True
+            except (ValueError, KeyError) as e:
+                log.warning("manifest record for %s unusable: %s", model, e)
+        return False
+
+    def manifest(self, model: str) -> dict | None:
+        with self._lock:
+            tensors = self._models.get(model)
+        if tensors is None and self._lazy_resolve(model):
+            with self._lock:
+                tensors = self._models.get(model)
+        if tensors is None:
+            return None
+        return {
+            "model": model,
+            "format": "safetensors-ranges",
+            "tensors": {
+                name: {"dtype": t.dtype, "shape": list(t.shape), "nbytes": t.nbytes}
+                for name, t in tensors.items()
+            },
+        }
+
+    def locate(self, model: str, tensor: str) -> _TensorLoc | None:
+        with self._lock:
+            loc = self._models.get(model, {}).get(tensor)
+        if loc is None and model not in self.models() and self._lazy_resolve(model):
+            with self._lock:
+                loc = self._models.get(model, {}).get(tensor)
+        return loc
+
+
+def make_handler(registry: RestoreRegistry, proxy=None):
+    class RestoreHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, status, body: bytes, ctype="application/json", extra=None):
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if self.command != "HEAD":
+                self.wfile.write(body)
+
+        def do_HEAD(self):
+            self.do_GET()
+
+        def do_PUT(self):
+            # push surface for the network-Orbax save path: the body is one
+            # safetensors blob; it commits to the content-addressed store
+            # and registers for restore (and for peer re-serving)
+            m = re.match(r"^/restore/(.+)/safetensors$", self.path)
+            if m is None:
+                self._send(404, b'{"error":"not found"}')
+                return
+            model = m.group(1)
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                length = 0
+            if length <= 0:
+                self._send(411, b'{"error":"Content-Length required"}')
+                return
+            try:
+                n = registry.put_safetensors(model, self.rfile, length)
+            except Exception as e:  # noqa: BLE001 — bad blob → client error
+                self._send(400, json.dumps({"error": str(e)}).encode())
+                return
+            metrics.HUB.inc("restore_put_total")
+            metrics.HUB.inc("restore_put_bytes_total", length)
+            self._send(200, json.dumps({"model": model, "tensors": n}).encode())
+
+        def do_GET(self):  # noqa: C901
+            if self.path == "/metrics":
+                # Prometheus exposition: hub counters + native proxy
+                # counters + store gauges (SURVEY.md §5 — the reference
+                # has no metrics endpoint at all)
+                body = metrics.render(proxy=proxy, store=registry.store).encode()
+                self._send(200, body, ctype="text/plain; version=0.0.4")
+                return
+            if self.path == "/restore/models":
+                self._send(200, json.dumps({"models": registry.models()}).encode())
+                return
+            m = re.match(r"^/restore/(.+)/manifest$", self.path)
+            if m:
+                manifest = registry.manifest(m.group(1))
+                if manifest is None:
+                    self._send(404, b'{"error":"model not registered"}')
+                    return
+                self._send(200, json.dumps(manifest).encode())
+                return
+            m = re.match(r"^/restore/(.+)/tensor/(.+)$", self.path)
+            if m:
+                loc = registry.locate(m.group(1), m.group(2))
+                if loc is None:
+                    self._send(404, b'{"error":"no such tensor"}')
+                    return
+                off, length, status = 0, loc.nbytes, 200
+                extra = {"Accept-Ranges": "bytes"}
+                rng = self.headers.get("Range")
+                if rng and rng.startswith("bytes="):
+                    # RFC 9110 §14.2: an unparsable Range is ignored; a
+                    # parsable-but-unsatisfiable one (past-end start,
+                    # reversed, zero suffix) gets 416
+                    try:
+                        a, _, b = rng[6:].partition("-")
+                        if a:
+                            off = int(a)
+                            end = int(b) if b else loc.nbytes - 1
+                        else:
+                            n = int(b)
+                            if n <= 0:
+                                self._send(416, b"")
+                                return
+                            off = max(0, loc.nbytes - n)
+                            end = loc.nbytes - 1
+                    except ValueError:
+                        off, end = 0, loc.nbytes - 1
+                    else:
+                        if off >= loc.nbytes or end < off:
+                            self._send(416, b"")
+                            return
+                        end = min(end, loc.nbytes - 1)
+                        status = 206
+                        extra["Content-Range"] = f"bytes {off}-{end}/{loc.nbytes}"
+                    length = end - off + 1
+                body = registry.store.pread(loc.key, length, loc.start + off)
+                metrics.HUB.inc("restore_tensor_requests_total")
+                metrics.HUB.inc("restore_bytes_total", len(body))
+                self._send(status, body, ctype="application/octet-stream", extra=extra)
+                return
+            self._send(404, b'{"error":"not found"}')
+
+    return RestoreHandler
+
+
+class RestoreServer:
+    """Threaded HTTP server over a RestoreRegistry. ``proxy`` (optional)
+    adds the native data-plane counters to ``/metrics``."""
+
+    def __init__(self, registry: RestoreRegistry, host: str = "0.0.0.0",
+                 port: int = 0, proxy=None):
+        self.registry = registry
+        self.httpd = ThreadingHTTPServer((host, port), make_handler(registry, proxy))
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> "RestoreServer":
+        self._thread.start()
+        log.info("restore API listening on :%d", self.port)
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

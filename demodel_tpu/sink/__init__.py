@@ -1,0 +1,13 @@
+from demodel_tpu.sink.hbm import (
+    Placement,
+    deliver_gguf,
+    deliver_report_to_hbm,
+    deliver_safetensors,
+    place_tensor,
+)
+from demodel_tpu.sink.plan import ShardingPlan
+from demodel_tpu.sink.streaming import StreamingSink
+
+__all__ = ["Placement", "deliver_gguf", "deliver_report_to_hbm",
+           "deliver_safetensors", "place_tensor", "ShardingPlan",
+           "StreamingSink"]

@@ -1,0 +1,43 @@
+"""Sharding plan: tensor name/shape → NamedSharding for delivery.
+
+The delivery-time analogue of a model's parallelism plan (SURVEY.md §2.3
+"Sharded HBM placement"): weight matrices shard on their leading axis over
+``tp`` (contiguous in safetensors/GGUF files, so every device's shard is a
+single range read); small tensors (biases, norms, scalars) replicate. A
+consumer with an exact layout (e.g. the Orbax network restore) passes its
+own shardings instead — the plan is the default, not a constraint.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from demodel_tpu.utils.env import env_int
+
+
+class ShardingPlan:
+    """Default placement rules over a mesh's ``tp`` axis.
+
+    ``min_shard_bytes``: tensors smaller than this replicate — sharding a
+    128-byte layernorm wastes more in dispatch than it saves in HBM
+    (override via ``DEMODEL_MIN_SHARD_KB``).
+    """
+
+    def __init__(self, mesh: Mesh, min_shard_bytes: int | None = None):
+        self.mesh = mesh
+        self.tp = int(mesh.shape.get("tp", 1))
+        if min_shard_bytes is None:
+            min_shard_bytes = env_int("DEMODEL_MIN_SHARD_KB", 4, minimum=0) << 10
+        self.min_shard_bytes = min_shard_bytes
+
+    def sharding_for(self, name: str, shape: tuple[int, ...],
+                     itemsize: int) -> NamedSharding:
+        del name  # rules are shape-driven; name kept for subclass overrides
+        nbytes = itemsize
+        for d in shape:
+            nbytes *= int(d)
+        if (len(shape) >= 2 and self.tp > 1 and shape[0] % self.tp == 0
+                and nbytes >= self.min_shard_bytes):
+            return NamedSharding(
+                self.mesh, P("tp", *([None] * (len(shape) - 1))))
+        return NamedSharding(self.mesh, P())

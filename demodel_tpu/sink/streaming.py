@@ -1,0 +1,192 @@
+"""Streaming sink: land shards in HBM *while* later shards still download.
+
+The reference's delivery is strictly download-then-load (proxy caches bytes,
+a foreign client loads them afterwards). The rebuild overlaps the two: the
+registry's parallel fetch workers hand each completed weight file to this
+sink (``on_file``), a dedicated worker turns it into sharded device arrays
+(range reads → ``device_put`` under the plan's ``NamedSharding``), and the
+north-star clock "cold pull → HBM" pays max(network, PCIe/ICI) instead of
+their sum.
+
+One worker thread is deliberate: host→device transfer for one chip
+serializes on the transfer engine anyway, and a single consumer keeps
+``jax`` dispatch single-threaded while fetch threads stay pure-network.
+
+Host RAM is bounded: artifacts that carry landing buffers (memory-first
+peer fetch) count against ``DEMODEL_SINK_BUFFER_MB``; ``submit`` blocks
+fetch workers once the admitted-but-undelivered window would exceed it,
+so peak host RAM stays at the in-flight window — never the whole model
+(a 70B/15-shard pull must not need 140 GB of host RAM).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+
+from jax.sharding import Mesh
+
+from demodel_tpu.sink.hbm import (
+    Placement,
+    deliver_file,
+    is_weight_file,
+    merge_placement,
+)
+from demodel_tpu.sink.plan import ShardingPlan
+from demodel_tpu.store import Store
+from demodel_tpu.parallel.mesh import make_mesh
+from demodel_tpu.utils.env import env_int
+from demodel_tpu.utils.logging import get_logger
+
+log = get_logger("sink.streaming")
+
+_DONE = object()
+
+
+class _Cancelled(Exception):
+    """Internal sentinel: drain the queue without delivering."""
+
+
+class StreamingSink:
+    """Consumes completed FileArtifacts, delivers weight files to HBM.
+
+    Thread-safe producer side (``submit`` may be called from any fetch
+    worker); ``finish()`` drains the queue, joins the worker, re-raises the
+    first delivery error, and returns the merged :class:`Placement`.
+    """
+
+    def __init__(self, store: Store, mesh: Mesh | None = None,
+                 plan: ShardingPlan | None = None, cast_to=None,
+                 overlap: bool | None = None,
+                 max_buffered_bytes: int | None = None):
+        self.store = store
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.plan = plan if plan is not None else ShardingPlan(self.mesh)
+        self.cast_to = cast_to
+        self.placement = Placement(mesh_desc=f"{dict(self.mesh.shape)}")
+        self._q: queue.Queue = queue.Queue()
+        self._err: BaseException | None = None
+        self._err_lock = threading.Lock()  # _err written from worker + caller
+        if overlap is None:
+            # device_put dispatch is a host memcpy that releases the GIL,
+            # so overlapping it with the (native, GIL-free) fetch pays even
+            # on a single-core host — measured: serializing them was the
+            # bulk of the r02 throughput regression
+            env = os.environ.get("DEMODEL_SINK_OVERLAP", "").strip().lower()
+            overlap = env not in ("0", "false", "no", "off")
+        self.overlap = overlap
+        if max_buffered_bytes is None:
+            max_buffered_bytes = env_int("DEMODEL_SINK_BUFFER_MB", 1024,
+                                         minimum=1) << 20
+        self.max_buffered = max_buffered_bytes
+        self._buffered = 0  # admitted-but-undelivered landing-buffer bytes
+        self._cv = threading.Condition()  # guards _buffered; woken on drain/err
+        self._worker = None
+        self._worker_lock = threading.Lock()
+        if overlap:
+            self._start_worker()
+
+    def _start_worker(self) -> None:
+        with self._worker_lock:
+            if self._worker is None:
+                self._worker = threading.Thread(target=self._run, daemon=True)
+                self._worker.start()
+
+    # ---- producer side (fetch threads)
+    def submit(self, artifact) -> None:
+        """Queue a completed artifact; non-weight files are ignored. An
+        artifact carrying a landing ``buffer`` (memory-first peer fetch) is
+        delivered from host memory without touching the store.
+
+        Blocks (backpressuring the fetch worker) while the admitted landing
+        buffers exceed ``max_buffered`` — the queue is bounded in *bytes*,
+        not items, because items span 44 bytes to multi-GB shards."""
+        name = artifact.name if hasattr(artifact, "name") else artifact["name"]
+        media = (artifact.media_type if hasattr(artifact, "media_type")
+                 else artifact.get("media_type", ""))
+        if not is_weight_file(name, media):
+            return
+        key = artifact.key if hasattr(artifact, "key") else artifact["key"]
+        buffer = getattr(artifact, "buffer", None)
+        nbytes = int(getattr(buffer, "nbytes", 0)) if buffer is not None else 0
+        if nbytes:
+            # a buffered artifact always needs a live consumer: deferred
+            # (no-overlap) mode would otherwise hold every landing buffer
+            # until finish() — the unbounded-RAM failure mode
+            self._start_worker()
+            with self._cv:
+                # always admit at least one buffer (a single shard larger
+                # than the budget must pass, not deadlock)
+                while (self._buffered > 0
+                       and self._buffered + nbytes > self.max_buffered
+                       and self._get_err() is None):
+                    self._cv.wait(0.2)
+                self._buffered += nbytes
+        self._q.put((name, key, buffer, nbytes))
+
+    # ---- consumer side
+    def _set_err(self, e: BaseException) -> None:
+        with self._err_lock:
+            if self._err is None:
+                self._err = e
+        with self._cv:
+            self._cv.notify_all()  # unblock backpressured producers
+
+    def _get_err(self) -> BaseException | None:
+        with self._err_lock:
+            return self._err
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _DONE:
+                return
+            name, key, buffer, nbytes = item
+            try:
+                if self._get_err() is not None:
+                    continue  # drain without working after first failure
+                try:
+                    placed = deliver_file(self.store, name, key, self.mesh,
+                                          self.plan, self.cast_to,
+                                          buffer=buffer)
+                    merge_placement(self.placement, placed)
+                    log.debug("streamed %s → %d tensors", name,
+                              len(placed.arrays))
+                except BaseException as e:  # noqa: BLE001 — reported at finish()
+                    self._set_err(e)
+            finally:
+                if nbytes:
+                    with self._cv:
+                        self._buffered -= nbytes
+                        self._cv.notify_all()
+
+    def cancel(self) -> None:
+        """Abandon delivery: drain queued files without doing the work.
+        Used on the pull-error path, where the placement would be discarded."""
+        self._set_err(_Cancelled())
+        self._q.put(_DONE)
+        if self._worker is not None:
+            self._worker.join()
+
+    def finish(self, block: bool = True) -> Placement:
+        """Wait for every queued file to land; return the merged placement."""
+        self._q.put(_DONE)
+        if self._worker is not None:
+            self._worker.join()
+        else:
+            self._run()  # deferred mode: deliver everything now, fetch done
+        err = self._get_err()
+        if isinstance(err, _Cancelled):
+            # the private sentinel must not escape to callers
+            raise RuntimeError("sink was cancelled before finish()")
+        if err is not None:
+            raise err
+        if block and self.placement.arrays:
+            import jax
+
+            jax.block_until_ready(list(self.placement.arrays.values()))
+        log.info("streamed %d tensors (%.1f MB) onto mesh %s",
+                 len(self.placement.arrays),
+                 self.placement.total_bytes / 1e6, self.placement.mesh_desc)
+        return self.placement

@@ -1,0 +1,4 @@
+from demodel_tpu.utils.env import env_bool, env_int
+from demodel_tpu.utils.logging import get_logger
+
+__all__ = ["env_bool", "env_int", "get_logger"]

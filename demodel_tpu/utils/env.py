@@ -1,0 +1,47 @@
+"""Degrade-not-crash env parsing.
+
+The reference's env handling panics the whole server on config mistakes
+(``mo.Result.MustGet``, ``start.go:170-173``); here a malformed value logs a
+warning and yields the default — a proxy node must not die because someone
+fat-fingered an integer.
+"""
+
+from __future__ import annotations
+
+import os
+
+from demodel_tpu.utils.logging import get_logger
+
+log = get_logger("env")
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("", "0", "false", "no", "off")
+
+
+def env_int(name: str, default: int, minimum: int | None = None) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        log.warning("%s=%r is not an integer; using default %d", name, raw,
+                    default)
+        return default
+    if minimum is not None and val < minimum:
+        log.warning("%s=%d below minimum %d; clamping", name, val, minimum)
+        return minimum
+    return val
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    low = raw.strip().lower()
+    if low in _TRUE:
+        return True
+    if low in _FALSE:
+        return False
+    log.warning("%s=%r is not a boolean; using default %s", name, raw, default)
+    return default

@@ -1,0 +1,45 @@
+"""Env-filtered structured logging.
+
+Successor of the legacy generation's ``tracing`` + ``RUST_LOG`` filtering
+(reference ``Cargo.lock:475-476``, ``CONTRIBUTING.md:18``): one-line records
+tagged ``[demodel-tpu <logger>] <level-letter> <message>``, level set by
+``DEMODEL_LOG`` (e.g. ``debug``, ``info``, ``warning``; default info).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+class _Fmt(logging.Formatter):
+    LETTER = {"DEBUG": "D", "INFO": "I", "WARNING": "W", "ERROR": "E",
+              "CRITICAL": "C"}
+
+    def format(self, record: logging.LogRecord) -> str:
+        letter = self.LETTER.get(record.levelname, "?")
+        return f"[demodel-tpu {record.name}] {letter} {record.getMessage()}"
+
+
+def _configure() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    root = logging.getLogger("demodel_tpu")
+    if not root.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(_Fmt())
+        root.addHandler(h)
+        root.propagate = False
+    level = os.environ.get("DEMODEL_LOG", "info").strip().upper()
+    root.setLevel(getattr(logging, level, logging.INFO))
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Logger ``demodel_tpu.<name>`` under the env-filtered root."""
+    _configure()
+    return logging.getLogger(f"demodel_tpu.{name}")

@@ -1,0 +1,74 @@
+"""Process-wide counters + Prometheus exposition.
+
+The reference has no metrics surface at all (SURVEY.md §5 — two
+``fmt.Println`` hooks); the rebuild exposes one ``/metrics`` endpoint that
+merges three sources: Python-side counters (this HUB), the native proxy's
+atomic counters (``dm_proxy_metrics`` JSON), and store gauges computed from
+the content-addressed index.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Hub:
+    """Thread-safe named counters (monotonic)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+
+    def inc(self, name: str, amount: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
+
+    def reset(self) -> None:  # tests only
+        with self._lock:
+            self._counters.clear()
+
+
+HUB = Hub()
+
+
+def _fmt(value: float) -> str:
+    return str(int(value)) if float(value).is_integer() else repr(value)
+
+
+def render(proxy=None, store=None) -> str:
+    """Prometheus text exposition (0.0.4): HUB counters as
+    ``demodel_<name>``, native proxy counters as ``demodel_proxy_<name>``,
+    store gauges as ``demodel_store_{objects,bytes}``."""
+    lines: list[str] = []
+    for name, value in sorted(HUB.snapshot().items()):
+        metric = f"demodel_{name}"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {_fmt(value)}")
+    if proxy is not None:
+        try:
+            native = proxy.metrics()
+        except Exception:  # noqa: BLE001 — metrics must never take a node down
+            native = {}
+        for name, value in sorted(native.items()):
+            metric = f"demodel_proxy_{name}"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {_fmt(value)}")
+    if store is not None:
+        try:
+            idx = store.index().get("keys", [])
+            lines.append("# TYPE demodel_store_objects gauge")
+            lines.append(f"demodel_store_objects {len(idx)}")
+            lines.append("# TYPE demodel_store_bytes gauge")
+            lines.append(
+                f"demodel_store_bytes {sum(e.get('size', 0) for e in idx)}")
+        except Exception:  # noqa: BLE001
+            pass
+    return "\n".join(lines) + "\n"

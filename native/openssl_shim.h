@@ -1,0 +1,158 @@
+// dlopen-based OpenSSL 3 binding.
+//
+// This image ships libssl.so.3 / libcrypto.so.3 at runtime but neither the
+// dev headers nor the .so linker symlinks, so the data plane declares the
+// minimal TLS surface itself and binds symbols on first use. Call sites use
+// the standard OpenSSL names (SSL_read, SSL_CTX_new, ...) — each name is a
+// macro over a bound function pointer, so the code body reads like normal
+// OpenSSL and would compile against real headers unchanged.
+#pragma once
+
+#include <dlfcn.h>
+
+#include <cstddef>
+#include <cstdio>
+#include <cstdlib>
+
+extern "C" {
+typedef struct dm_ssl_ctx_st SSL_CTX;
+typedef struct dm_ssl_st SSL;
+typedef struct dm_ssl_method_st SSL_METHOD;
+typedef struct dm_x509_vfy_param_st X509_VERIFY_PARAM;
+}
+
+// constants (stable OpenSSL ABI values; DM_ prefix because the real macros
+// live in headers we don't have)
+#define DM_SSL_FILETYPE_PEM 1
+#define DM_SSL_VERIFY_PEER 0x01
+#define DM_SSL_ERROR_ZERO_RETURN 6
+#define DM_SSL_CTRL_SET_TLSEXT_HOSTNAME 55
+#define DM_TLSEXT_NAMETYPE_host_name 0
+
+namespace dm_ssl {
+
+struct Api {
+  const SSL_METHOD *(*TLS_server_method_)(void);
+  const SSL_METHOD *(*TLS_client_method_)(void);
+  SSL_CTX *(*SSL_CTX_new_)(const SSL_METHOD *);
+  void (*SSL_CTX_free_)(SSL_CTX *);
+  int (*SSL_CTX_use_certificate_chain_file_)(SSL_CTX *, const char *);
+  int (*SSL_CTX_use_PrivateKey_file_)(SSL_CTX *, const char *, int);
+  int (*SSL_CTX_check_private_key_)(const SSL_CTX *);
+  int (*SSL_CTX_set_default_verify_paths_)(SSL_CTX *);
+  int (*SSL_CTX_load_verify_locations_)(SSL_CTX *, const char *, const char *);
+  void (*SSL_CTX_set_verify_)(SSL_CTX *, int, void *);
+  SSL *(*SSL_new_)(SSL_CTX *);
+  void (*SSL_free_)(SSL *);
+  int (*SSL_set_fd_)(SSL *, int);
+  int (*SSL_accept_)(SSL *);
+  int (*SSL_connect_)(SSL *);
+  int (*SSL_read_)(SSL *, void *, int);
+  int (*SSL_write_)(SSL *, const void *, int);
+  int (*SSL_shutdown_)(SSL *);
+  int (*SSL_get_error_)(const SSL *, int);
+  long (*SSL_ctrl_)(SSL *, int, long, void *);
+  X509_VERIFY_PARAM *(*SSL_get0_param_)(SSL *);
+  int (*SSL_set1_host_)(SSL *, const char *);
+  int (*X509_VERIFY_PARAM_set1_ip_asc_)(X509_VERIFY_PARAM *, const char *);
+  unsigned long (*ERR_get_error_)(void);
+  void (*ERR_error_string_n_)(unsigned long, char *, size_t);
+  void (*ERR_clear_error_)(void);
+};
+
+inline Api &api() {
+  static Api a = [] {
+    Api x = {};
+    void *ssl = ::dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!ssl) ssl = ::dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    void *crypto = ::dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!crypto) crypto = ::dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!ssl || !crypto) {
+      ::fprintf(stderr, "[demodel-tpu] fatal: cannot dlopen OpenSSL: %s\n",
+                ::dlerror());
+      ::abort();
+    }
+    auto need = [](void *h, const char *name) -> void * {
+      void *s = ::dlsym(h, name);
+      if (!s) {
+        ::fprintf(stderr, "[demodel-tpu] fatal: missing OpenSSL symbol %s\n",
+                  name);
+        ::abort();
+      }
+      return s;
+    };
+#define DM_BIND(h, field, name) \
+  x.field = reinterpret_cast<decltype(x.field)>(need(h, name))
+    DM_BIND(ssl, TLS_server_method_, "TLS_server_method");
+    DM_BIND(ssl, TLS_client_method_, "TLS_client_method");
+    DM_BIND(ssl, SSL_CTX_new_, "SSL_CTX_new");
+    DM_BIND(ssl, SSL_CTX_free_, "SSL_CTX_free");
+    DM_BIND(ssl, SSL_CTX_use_certificate_chain_file_,
+            "SSL_CTX_use_certificate_chain_file");
+    DM_BIND(ssl, SSL_CTX_use_PrivateKey_file_, "SSL_CTX_use_PrivateKey_file");
+    DM_BIND(ssl, SSL_CTX_check_private_key_, "SSL_CTX_check_private_key");
+    DM_BIND(ssl, SSL_CTX_set_default_verify_paths_,
+            "SSL_CTX_set_default_verify_paths");
+    DM_BIND(ssl, SSL_CTX_load_verify_locations_,
+            "SSL_CTX_load_verify_locations");
+    DM_BIND(ssl, SSL_CTX_set_verify_, "SSL_CTX_set_verify");
+    DM_BIND(ssl, SSL_new_, "SSL_new");
+    DM_BIND(ssl, SSL_free_, "SSL_free");
+    DM_BIND(ssl, SSL_set_fd_, "SSL_set_fd");
+    DM_BIND(ssl, SSL_accept_, "SSL_accept");
+    DM_BIND(ssl, SSL_connect_, "SSL_connect");
+    DM_BIND(ssl, SSL_read_, "SSL_read");
+    DM_BIND(ssl, SSL_write_, "SSL_write");
+    DM_BIND(ssl, SSL_shutdown_, "SSL_shutdown");
+    DM_BIND(ssl, SSL_get_error_, "SSL_get_error");
+    DM_BIND(ssl, SSL_ctrl_, "SSL_ctrl");
+    DM_BIND(ssl, SSL_get0_param_, "SSL_get0_param");
+    DM_BIND(ssl, SSL_set1_host_, "SSL_set1_host");
+    DM_BIND(crypto, X509_VERIFY_PARAM_set1_ip_asc_,
+            "X509_VERIFY_PARAM_set1_ip_asc");
+    DM_BIND(crypto, ERR_get_error_, "ERR_get_error");
+    DM_BIND(crypto, ERR_error_string_n_, "ERR_error_string_n");
+    DM_BIND(crypto, ERR_clear_error_, "ERR_clear_error");
+#undef DM_BIND
+    return x;
+  }();
+  return a;
+}
+
+}  // namespace dm_ssl
+
+#define TLS_server_method (dm_ssl::api().TLS_server_method_)
+#define TLS_client_method (dm_ssl::api().TLS_client_method_)
+#define SSL_CTX_new (dm_ssl::api().SSL_CTX_new_)
+#define SSL_CTX_free (dm_ssl::api().SSL_CTX_free_)
+#define SSL_CTX_use_certificate_chain_file \
+  (dm_ssl::api().SSL_CTX_use_certificate_chain_file_)
+#define SSL_CTX_use_PrivateKey_file (dm_ssl::api().SSL_CTX_use_PrivateKey_file_)
+#define SSL_CTX_check_private_key (dm_ssl::api().SSL_CTX_check_private_key_)
+#define SSL_CTX_set_default_verify_paths \
+  (dm_ssl::api().SSL_CTX_set_default_verify_paths_)
+#define SSL_CTX_load_verify_locations \
+  (dm_ssl::api().SSL_CTX_load_verify_locations_)
+#define SSL_CTX_set_verify (dm_ssl::api().SSL_CTX_set_verify_)
+#define SSL_new (dm_ssl::api().SSL_new_)
+#define SSL_free (dm_ssl::api().SSL_free_)
+#define SSL_set_fd (dm_ssl::api().SSL_set_fd_)
+#define SSL_accept (dm_ssl::api().SSL_accept_)
+#define SSL_connect (dm_ssl::api().SSL_connect_)
+#define SSL_read (dm_ssl::api().SSL_read_)
+#define SSL_write (dm_ssl::api().SSL_write_)
+#define SSL_shutdown (dm_ssl::api().SSL_shutdown_)
+#define SSL_get_error (dm_ssl::api().SSL_get_error_)
+#define SSL_ctrl (dm_ssl::api().SSL_ctrl_)
+#define SSL_get0_param (dm_ssl::api().SSL_get0_param_)
+#define SSL_set1_host (dm_ssl::api().SSL_set1_host_)
+#define X509_VERIFY_PARAM_set1_ip_asc \
+  (dm_ssl::api().X509_VERIFY_PARAM_set1_ip_asc_)
+#define ERR_get_error (dm_ssl::api().ERR_get_error_)
+#define ERR_error_string_n (dm_ssl::api().ERR_error_string_n_)
+#define ERR_clear_error (dm_ssl::api().ERR_clear_error_)
+
+#define SSL_set_tlsext_host_name(s, name)                        \
+  SSL_ctrl((s), DM_SSL_CTRL_SET_TLSEXT_HOSTNAME,                 \
+           DM_TLSEXT_NAMETYPE_host_name,                         \
+           reinterpret_cast<void *>(const_cast<char *>(name)))

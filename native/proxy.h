@@ -1,0 +1,121 @@
+// MITM caching forward proxy — the C++ data plane under demodel_tpu.proxy.
+//
+// Capability parity with the reference's Go generation (CONNECT handling,
+// selective MITM by exact "host:port" match / all / none — policy order per
+// `cmd/demodel/start.go:183-196`) plus the legacy-Rust generation's
+// tee-to-cache (reference CONTRIBUTING.md:53-154), rebuilt as an owned
+// event-per-connection server: CONNECT parsing, double TLS handshake (leaf
+// mint via Python callback, upstream verify), streaming splice, range-aware
+// cache serving, ranged-miss fill with reader attach, and the native peer
+// DCN fetch paths. proxy.cc owns all per-connection logic; this header is
+// the Proxy object + config surface for the C API.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "openssl_shim.h"
+#include "store.h"
+
+namespace dm {
+
+// Leaf-mint callback into Python PKI: writes cert/key PEM *file paths* into
+// the caller's buffers (cap bytes each); nonzero = mint failure.
+typedef int (*MintCb)(const char *host, char *cert_path_out,
+                      char *key_path_out, int cap);
+
+struct ProxyConfig {
+  std::string host = "127.0.0.1";
+  int port = 0;  // 0 → ephemeral, report via Proxy::port()
+  bool mitm_all = false;
+  bool no_mitm = false;
+  std::vector<std::string> mitm_hosts;  // exact "host:port" matches
+  std::string store_root;               // empty → caching disabled
+  std::string upstream_ca;              // extra CA for upstream verify
+  bool cache_enabled = true;
+  MintCb mint = nullptr;
+  bool verbose = false;
+  int io_timeout_sec = 75;
+  int64_t max_body_bytes = 64ll << 20;  // request-body cap (413 beyond)
+};
+
+struct Metrics {
+  std::atomic<uint64_t> connects{0}, mitm{0}, tunnel{0}, requests{0},
+      cache_hits{0}, cache_misses{0}, bytes_up{0}, bytes_down{0},
+      bytes_cache{0}, errors{0};
+  std::string json() const;
+};
+
+// Shared state of an in-flight ranged-miss cache fill: the filling session
+// streams the full object into partial/<key> while attached readers wait on
+// (total, written) to serve their windows from the growing partial.
+struct FillState {
+  std::mutex mu;
+  std::condition_variable cv;
+  int64_t total = -1;   // -1 until the upstream response head arrives
+  int64_t written = 0;  // bytes landed in the partial so far
+  bool done = false;
+  bool ok = false;
+};
+
+class Session;
+
+class Proxy {
+ public:
+  explicit Proxy(ProxyConfig cfg);
+  ~Proxy();
+  Proxy(const Proxy &) = delete;
+  Proxy &operator=(const Proxy &) = delete;
+
+  int start();  // bind+listen+accept thread; 0 or -errno
+  void stop();  // joins accept thread, force-closes live sessions
+  int port() const { return port_; }
+  Metrics &metrics() { return metrics_; }
+
+  bool should_mitm(const std::string &authority) const;
+  SSL_CTX *leaf_ctx(const std::string &host, std::string *err);
+  SSL_CTX *upstream_ctx();
+
+  // signed-CDN digest hints: a 302's X-Linked-Etag recorded against the
+  // redirect target lets the next fresh-signature URL dedup by content
+  void record_hint(const std::string &authority, const std::string &location,
+                   const std::string &digest);
+  std::string hint_digest(const std::string &authority,
+                          const std::string &target);
+
+ private:
+  friend class Session;
+
+  ProxyConfig cfg_;
+  Store *store_ = nullptr;
+  Metrics metrics_;
+
+  std::mutex leaf_mu_;
+  std::unordered_map<std::string, SSL_CTX *> leaf_ctxs_;
+  std::mutex upstream_mu_;
+  SSL_CTX *upstream_ctx_ = nullptr;
+
+  std::mutex hint_mu_;
+  std::unordered_map<std::string, std::string> digest_hints_;
+
+  std::mutex fill_mu_;
+  std::unordered_map<std::string, std::shared_ptr<FillState>> fills_;
+
+  std::mutex sessions_mu_;
+  std::set<Session *> sessions_;
+  std::atomic<bool> running_{false};
+  std::atomic<int> live_sessions_{0};
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::thread accept_thread_;
+};
+
+}  // namespace dm

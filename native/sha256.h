@@ -1,0 +1,109 @@
+// Streaming SHA-256 over libcrypto's EVP (dlopen-bound like openssl_shim.h —
+// no dev headers in this image). EVP picks the SHA-NI/AVX2 assembly paths,
+// which is what lets the parallel range fetch hash multi-GB checkpoints in a
+// single post-transfer pass (see RangeWriter::commit).
+#pragma once
+
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+namespace dm {
+
+namespace evp {
+
+extern "C" {
+typedef struct dm_evp_md_ctx_st EVP_MD_CTX;
+typedef struct dm_evp_md_st EVP_MD;
+}
+
+struct Api {
+  EVP_MD_CTX *(*ctx_new)(void);
+  void (*ctx_free)(EVP_MD_CTX *);
+  const EVP_MD *(*sha256)(void);
+  int (*init_ex)(EVP_MD_CTX *, const EVP_MD *, void *);
+  int (*update)(EVP_MD_CTX *, const void *, size_t);
+  int (*final_ex)(EVP_MD_CTX *, unsigned char *, unsigned int *);
+  int (*copy_ex)(EVP_MD_CTX *, const EVP_MD_CTX *);
+};
+
+inline Api &api() {
+  static Api a = [] {
+    Api x = {};
+    void *h = ::dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = ::dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) {
+      ::fprintf(stderr, "[demodel-tpu] fatal: cannot dlopen libcrypto: %s\n",
+                ::dlerror());
+      ::abort();
+    }
+    auto need = [h](const char *name) -> void * {
+      void *s = ::dlsym(h, name);
+      if (!s) {
+        ::fprintf(stderr, "[demodel-tpu] fatal: missing EVP symbol %s\n", name);
+        ::abort();
+      }
+      return s;
+    };
+    x.ctx_new = reinterpret_cast<decltype(x.ctx_new)>(need("EVP_MD_CTX_new"));
+    x.ctx_free = reinterpret_cast<decltype(x.ctx_free)>(need("EVP_MD_CTX_free"));
+    x.sha256 = reinterpret_cast<decltype(x.sha256)>(need("EVP_sha256"));
+    x.init_ex = reinterpret_cast<decltype(x.init_ex)>(need("EVP_DigestInit_ex"));
+    x.update = reinterpret_cast<decltype(x.update)>(need("EVP_DigestUpdate"));
+    x.final_ex =
+        reinterpret_cast<decltype(x.final_ex)>(need("EVP_DigestFinal_ex"));
+    x.copy_ex =
+        reinterpret_cast<decltype(x.copy_ex)>(need("EVP_MD_CTX_copy_ex"));
+    return x;
+  }();
+  return a;
+}
+
+}  // namespace evp
+
+class Sha256 {
+ public:
+  Sha256() : ctx_(evp::api().ctx_new()) {
+    evp::api().init_ex(ctx_, evp::api().sha256(), nullptr);
+  }
+  ~Sha256() { evp::api().ctx_free(ctx_); }
+  Sha256(const Sha256 &) = delete;
+  Sha256 &operator=(const Sha256 &) = delete;
+
+  void update(const void *data, size_t len) {
+    evp::api().update(ctx_, data, len);
+  }
+
+  // hex of everything update()'d so far. Finalizes a COPY of the running
+  // state, so a mid-stream digest peek does not disturb the stream (the
+  // store exposes this to let pullers verify while bytes are in flight).
+  std::string hex() {
+    unsigned char md[32];
+    unsigned int n = 0;
+    evp::EVP_MD_CTX *tmp = evp::api().ctx_new();
+    evp::api().copy_ex(tmp, ctx_);
+    evp::api().final_ex(tmp, md, &n);
+    evp::api().ctx_free(tmp);
+    static const char *d = "0123456789abcdef";
+    std::string out;
+    out.reserve(64);
+    for (unsigned int i = 0; i < n; i++) {
+      out.push_back(d[md[i] >> 4]);
+      out.push_back(d[md[i] & 0xf]);
+    }
+    return out;
+  }
+
+  static std::string hex_of(const void *data, size_t len) {
+    Sha256 s;
+    s.update(data, len);
+    return s.hex();
+  }
+
+ private:
+  evp::EVP_MD_CTX *ctx_;
+};
+
+}  // namespace dm

@@ -1,0 +1,802 @@
+#include "store.h"
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <vector>
+
+#include "sha256.h"
+
+namespace dm {
+
+static bool is_safe_key(const std::string &key) {
+  if (key.empty() || key.size() > 128) return false;
+  for (char c : key) {
+    bool ok = (c >= '0' && c <= '9') || (c >= 'a' && c <= 'z') ||
+              (c >= 'A' && c <= 'Z') || c == '-' || c == '_' || c == '.' || c == ':';
+    if (!ok) return false;
+  }
+  // no traversal
+  return key.find("..") == std::string::npos;
+}
+
+static bool is_hex_digest(const std::string &d) {
+  if (d.size() != 64) return false;
+  for (char c : d)
+    if (!((c >= '0' && c <= '9') || (c >= 'a' && c <= 'f'))) return false;
+  return true;
+}
+
+std::string key_for_uri(const std::string &uri) {
+  return Sha256::hex_of(uri.data(), uri.size()).substr(0, 16);
+}
+
+std::string meta_scan(const std::string &meta, const char *name) {
+  std::string pat = std::string("\"") + name + "\":";
+  auto pos = meta.find(pat);
+  if (pos == std::string::npos) return "";
+  pos += pat.size();
+  // tolerate json.dumps' default ": " separator (Python-composed sidecars)
+  while (pos < meta.size() && (meta[pos] == ' ' || meta[pos] == '\t')) pos++;
+  if (pos >= meta.size() || meta[pos] != '"') return "";
+  pos++;
+  std::string out;
+  while (pos < meta.size() && meta[pos] != '"') {
+    if (meta[pos] == '\\' && pos + 1 < meta.size()) pos++;
+    out.push_back(meta[pos++]);
+  }
+  return out;
+}
+
+bool Store::meta_is_private(const std::string &meta_json) {
+  return !meta_scan(meta_json, "auth_scope").empty();
+}
+
+std::string Store::meta_digest(const std::string &meta_json) {
+  std::string d = meta_scan(meta_json, "sha256");
+  return is_hex_digest(d) ? d : "";
+}
+
+// ----------------------------------------------------------------- Writer
+
+Writer::Writer(Store *store, std::string key, int fd, int64_t offset, void *sha)
+    : store_(store), key_(std::move(key)), fd_(fd), offset_(offset), sha_(sha) {}
+
+Writer::~Writer() {
+  if (!done_) abort(true);
+  delete static_cast<Sha256 *>(sha_);
+}
+
+int Writer::append(const void *buf, int64_t len) {
+  const char *p = static_cast<const char *>(buf);
+  int64_t left = len;
+  while (left > 0) {
+    ssize_t n = ::write(fd_, p, static_cast<size_t>(left));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    p += n;
+    left -= n;
+  }
+  static_cast<Sha256 *>(sha_)->update(buf, static_cast<size_t>(len));
+  offset_ += len;
+  return 0;
+}
+
+std::string Writer::digest() { return static_cast<Sha256 *>(sha_)->hex(); }
+
+int Writer::commit(const std::string &meta_json) {
+  if (done_) return -EINVAL;
+  ::fsync(fd_);
+  ::close(fd_);
+  fd_ = -1;
+  int rc = store_->publish(key_, meta_json, digest());
+  done_ = true;
+  store_->finish_writer(key_);
+  return rc;
+}
+
+int Writer::abort(bool keep_partial) {
+  if (done_) return -EINVAL;
+  if (fd_ >= 0) ::close(fd_);
+  fd_ = -1;
+  if (!keep_partial) ::unlink(store_->part_path(key_).c_str());
+  done_ = true;
+  store_->finish_writer(key_);
+  return 0;
+}
+
+// ------------------------------------------------------------ RangeWriter
+
+RangeWriter::RangeWriter(Store *store, std::string key, int fd, int64_t total)
+    : store_(store), key_(std::move(key)), fd_(fd), total_(total) {}
+
+RangeWriter::~RangeWriter() {
+  if (!done_) abort(false);
+}
+
+int RangeWriter::pwrite_at(const void *buf, int64_t len, int64_t off) {
+  if (off < 0 || len < 0 || off + len > total_) return -EINVAL;
+  const char *p = static_cast<const char *>(buf);
+  int64_t left = len, pos = off;
+  while (left > 0) {
+    ssize_t n = ::pwrite(fd_, p, static_cast<size_t>(left), pos);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    p += n;
+    pos += n;
+    left -= n;
+  }
+  if (len == 0) return 0;
+  // merge [off, off+len) into the coverage set — overlapping retries after a
+  // mid-range error must not double-count, and gaps must stay visible
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t a = off, b = off + len;
+  auto it = cov_.upper_bound(a);
+  if (it != cov_.begin()) {
+    auto prev = std::prev(it);
+    if (prev->second >= a) {
+      a = prev->first;
+      b = std::max(b, prev->second);
+      it = cov_.erase(prev);
+    }
+  }
+  while (it != cov_.end() && it->first <= b) {
+    b = std::max(b, it->second);
+    it = cov_.erase(it);
+  }
+  cov_[a] = b;
+  return 0;
+}
+
+int64_t RangeWriter::written() const {
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t sum = 0;
+  for (auto &p : cov_) sum += p.second - p.first;
+  return sum;
+}
+
+int RangeWriter::commit(const std::string &meta_json,
+                        const std::string &expected_digest, char *digest_out) {
+  if (done_) return -EINVAL;
+  if (written() != total_) {
+    abort(false);
+    return -EIO;
+  }
+  ::fsync(fd_);
+  // single sequential hash pass (EVP sha256 runs multi-GB/s with SHA-NI;
+  // keeping it out of the per-range loops lets N sockets write at line rate)
+  Sha256 sha;
+  std::vector<char> buf(4 << 20);
+  int64_t off = 0;
+  while (off < total_) {
+    ssize_t n = ::pread(fd_, buf.data(), buf.size(),  off);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      int e = -errno;
+      abort(false);
+      return e;
+    }
+    if (n == 0) {
+      abort(false);
+      return -EIO;
+    }
+    sha.update(buf.data(), static_cast<size_t>(n));
+    off += n;
+  }
+  std::string digest = sha.hex();
+  if (digest_out) ::memcpy(digest_out, digest.c_str(), digest.size() + 1);
+  if (!expected_digest.empty() && digest != expected_digest) {
+    abort(false);
+    return -EBADMSG;
+  }
+  ::close(fd_);
+  fd_ = -1;
+  int rc = store_->publish(key_, meta_json, digest);
+  done_ = true;
+  store_->finish_writer(key_);
+  return rc;
+}
+
+int RangeWriter::abort(bool keep_partial) {
+  if (done_) return -EINVAL;
+  if (fd_ >= 0) ::close(fd_);
+  fd_ = -1;
+  if (!keep_partial) ::unlink(store_->part_path(key_).c_str());
+  done_ = true;
+  store_->finish_writer(key_);
+  return 0;
+}
+
+// ------------------------------------------------------------------- Store
+
+static int mkdir_p(const std::string &path) {
+  if (::mkdir(path.c_str(), 0755) == 0 || errno == EEXIST) return 0;
+  return -errno;
+}
+
+Store *Store::open(const std::string &root, std::string *err) {
+  for (const char *sub : {"", "/objects", "/partial", "/digests"}) {
+    std::string p = root + sub;
+    // create parents of root lazily too (cache_dir may not exist yet)
+    if (sub[0] == 0) {
+      std::string acc;
+      for (size_t i = 0; i < p.size(); i++) {
+        if (p[i] == '/' && i > 0) {
+          if (mkdir_p(acc) != 0 && errno != EEXIST) break;
+        }
+        acc.push_back(p[i]);
+      }
+    }
+    int rc = mkdir_p(p);
+    if (rc != 0) {
+      if (err) *err = "mkdir " + p + ": " + ::strerror(-rc);
+      return nullptr;
+    }
+  }
+  return new Store(root);
+}
+
+Store::~Store() {
+  std::lock_guard<std::mutex> g(fd_mu_);
+  for (auto &p : fd_cache_) ::close(p.second);
+  fd_cache_.clear();
+}
+
+std::string Store::obj_path(const std::string &key) const {
+  return root_ + "/objects/" + key;
+}
+std::string Store::meta_path(const std::string &key) const {
+  return root_ + "/objects/" + key + ".meta";
+}
+std::string Store::part_path(const std::string &key) const {
+  return root_ + "/partial/" + key;
+}
+std::string Store::digest_path(const std::string &digest) const {
+  return root_ + "/digests/" + digest;
+}
+
+bool Store::has(const std::string &key) {
+  if (!is_safe_key(key)) return false;
+  struct stat st;
+  return ::stat(obj_path(key).c_str(), &st) == 0 && S_ISREG(st.st_mode);
+}
+
+int64_t Store::size(const std::string &key) {
+  if (!is_safe_key(key)) return -1;
+  struct stat st;
+  if (::stat(obj_path(key).c_str(), &st) != 0) return -1;
+  return static_cast<int64_t>(st.st_size);
+}
+
+int64_t Store::partial_size(const std::string &key) {
+  if (!is_safe_key(key)) return 0;
+  struct stat st;
+  if (::stat(part_path(key).c_str(), &st) != 0) return 0;
+  return static_cast<int64_t>(st.st_size);
+}
+
+std::string Store::meta(const std::string &key) {
+  if (!is_safe_key(key)) return "";
+  int fd = ::open(meta_path(key).c_str(), O_RDONLY | O_CLOEXEC);
+  if (fd < 0) return "";
+  std::string out;
+  char buf[4096];
+  ssize_t n;
+  while ((n = ::read(fd, buf, sizeof buf)) > 0) out.append(buf, static_cast<size_t>(n));
+  ::close(fd);
+  return out;
+}
+
+bool Store::is_private(const std::string &key) {
+  return meta_is_private(meta(key));
+}
+
+bool Store::has_digest(const std::string &digest) {
+  if (!is_hex_digest(digest)) return false;
+  struct stat st;
+  return ::stat(digest_path(digest).c_str(), &st) == 0;
+}
+
+int64_t Store::pread(const std::string &key, void *buf, int64_t len, int64_t off) {
+  if (!is_safe_key(key)) return -EINVAL;
+  int fd = open_read_fd(key);
+  if (fd < 0) return -ENOENT;
+  char *p = static_cast<char *>(buf);
+  int64_t got = 0;
+  while (got < len) {
+    ssize_t n = ::pread(fd, p + got, static_cast<size_t>(len - got), off + got);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      ::close(fd);
+      return -errno;
+    }
+    if (n == 0) break;
+    got += n;
+  }
+  ::close(fd);
+  return got;
+}
+
+int Store::open_read_fd(const std::string &key) {
+  if (!is_safe_key(key)) return -1;
+  std::lock_guard<std::mutex> g(fd_mu_);
+  auto it = fd_cache_.find(key);
+  if (it != fd_cache_.end()) {
+    // validate: a recommit replaces the inode; a stale fd would serve old bytes
+    struct stat cached, ondisk;
+    if (::fstat(it->second, &cached) == 0 &&
+        ::stat(obj_path(key).c_str(), &ondisk) == 0 &&
+        cached.st_ino == ondisk.st_ino) {
+      int dup_fd = ::fcntl(it->second, F_DUPFD_CLOEXEC, 0);
+      if (dup_fd >= 0) return dup_fd;
+    }
+    ::close(it->second);
+    fd_cache_.erase(it);
+  }
+  int fd = ::open(obj_path(key).c_str(), O_RDONLY | O_CLOEXEC);
+  if (fd < 0) return -1;
+  if (fd_cache_.size() >= 64) {  // small bound; eviction order is arbitrary
+    auto victim = fd_cache_.begin();
+    ::close(victim->second);
+    fd_cache_.erase(victim);
+  }
+  int dup_fd = ::fcntl(fd, F_DUPFD_CLOEXEC, 0);
+  fd_cache_[key] = fd;
+  return dup_fd >= 0 ? dup_fd : ::open(obj_path(key).c_str(), O_RDONLY | O_CLOEXEC);
+}
+
+bool Store::claim_writer(const std::string &key) {
+  std::lock_guard<std::mutex> g(writers_mu_);
+  return active_writers_.insert(key).second;
+}
+
+void Store::finish_writer(const std::string &key) {
+  std::lock_guard<std::mutex> g(writers_mu_);
+  active_writers_.erase(key);
+}
+
+Writer *Store::begin(const std::string &key, bool resume, std::string *err) {
+  if (!is_safe_key(key)) {
+    if (err) *err = "unsafe key";
+    return nullptr;
+  }
+  if (!claim_writer(key)) {
+    if (err) *err = "writer already active for key";
+    return nullptr;
+  }
+  int flags = O_WRONLY | O_CREAT | O_CLOEXEC | (resume ? O_APPEND : O_TRUNC);
+  int fd = ::open(part_path(key).c_str(), flags, 0644);
+  if (fd < 0) {
+    if (err) *err = std::string("open partial: ") + ::strerror(errno);
+    finish_writer(key);
+    return nullptr;
+  }
+  int64_t offset = 0;
+  auto *sha = new Sha256();
+  if (resume) {
+    // the running digest must cover the existing bytes: rehash the partial
+    struct stat st;
+    if (::fstat(fd, &st) == 0) offset = static_cast<int64_t>(st.st_size);
+    int rfd = ::open(part_path(key).c_str(), O_RDONLY | O_CLOEXEC);
+    if (rfd >= 0) {
+      std::vector<char> buf(1 << 20);
+      ssize_t n;
+      while ((n = ::read(rfd, buf.data(), buf.size())) > 0)
+        sha->update(buf.data(), static_cast<size_t>(n));
+      ::close(rfd);
+    }
+  }
+  return new Writer(this, key, fd, offset, sha);
+}
+
+RangeWriter *Store::begin_ranged(const std::string &key, int64_t total,
+                                 std::string *err) {
+  if (!is_safe_key(key) || total < 0) {
+    if (err) *err = "unsafe key or bad total";
+    return nullptr;
+  }
+  if (!claim_writer(key)) {
+    if (err) *err = "writer already active for key";
+    return nullptr;
+  }
+  int fd = ::open(part_path(key).c_str(), O_RDWR | O_CREAT | O_TRUNC | O_CLOEXEC,
+                  0644);
+  if (fd < 0) {
+    if (err) *err = std::string("open partial: ") + ::strerror(errno);
+    finish_writer(key);
+    return nullptr;
+  }
+  if (total > 0 && ::ftruncate(fd, total) != 0) {
+    if (err) *err = std::string("preallocate: ") + ::strerror(errno);
+    ::close(fd);
+    finish_writer(key);
+    return nullptr;
+  }
+  return new RangeWriter(this, key, fd, total);
+}
+
+void Store::drop_digest_ref(const std::string &key, const std::string &old_meta) {
+  // if this key held the digests/ link's bytes and no other object does,
+  // retire the link (content-address map must not point at freed content)
+  std::string digest = meta_digest(old_meta);
+  if (digest.empty()) return;
+  struct stat obj, link;
+  if (::stat(digest_path(digest).c_str(), &link) != 0) return;
+  if (::stat(obj_path(key).c_str(), &obj) == 0 && obj.st_ino == link.st_ino &&
+      link.st_nlink > 2) {
+    return;  // another objects/<key'> hardlink still holds these bytes
+  }
+  if (obj.st_ino == link.st_ino || link.st_nlink <= 1)
+    ::unlink(digest_path(digest).c_str());
+}
+
+int Store::publish(const std::string &key, const std::string &meta_json,
+                   const std::string &digest) {
+  // meta sidecar first (tmp+rename), then body rename — a reader that sees
+  // the object always finds its meta
+  std::string old_meta = meta(key);
+  std::string mtmp = meta_path(key) + ".tmp";
+  int mfd = ::open(mtmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+  if (mfd < 0) return -errno;
+  std::string enriched = meta_json;
+  // ensure the digest is queryable from the sidecar even when the caller's
+  // meta omitted it (content-address index depends on it)
+  if (meta_scan(enriched, "sha256").empty() && is_hex_digest(digest)) {
+    auto brace = enriched.rfind('}');
+    if (brace != std::string::npos) {
+      std::string ins = std::string(enriched[brace - 1] == '{' ? "" : ", ") +
+                        "\"sha256\": \"" + digest + "\"";
+      enriched.insert(brace, ins);
+    }
+  }
+  ssize_t wr = ::write(mfd, enriched.data(), enriched.size());
+  ::fsync(mfd);
+  ::close(mfd);
+  if (wr != static_cast<ssize_t>(enriched.size())) {
+    ::unlink(mtmp.c_str());
+    return -EIO;
+  }
+  if (::rename(mtmp.c_str(), meta_path(key).c_str()) != 0) return -errno;
+  if (!old_meta.empty()) drop_digest_ref(key, old_meta);
+  if (::rename(part_path(key).c_str(), obj_path(key).c_str()) != 0) return -errno;
+  {
+    // recommit under the same key: retire any stale cached fd
+    std::lock_guard<std::mutex> g(fd_mu_);
+    auto it = fd_cache_.find(key);
+    if (it != fd_cache_.end()) {
+      ::close(it->second);
+      fd_cache_.erase(it);
+    }
+  }
+  // content-address hardlink — PRIVATE (auth-scoped) objects stay out of
+  // the digest map so cross-user dedup can never leak their bytes
+  if (is_hex_digest(digest) && !meta_is_private(enriched)) {
+    ::unlink(digest_path(digest).c_str());
+    ::link(obj_path(key).c_str(), digest_path(digest).c_str());
+  }
+  invalidate_index();
+  return 0;
+}
+
+int Store::put(const std::string &key, const void *body, int64_t len,
+               const std::string &meta_json, char *digest_out) {
+  std::string err;
+  Writer *w = begin(key, false, &err);
+  if (!w) return -EBUSY;
+  int rc = w->append(body, len);
+  if (rc == 0) {
+    std::string digest = w->digest();
+    if (digest_out) ::memcpy(digest_out, digest.c_str(), digest.size() + 1);
+    rc = w->commit(meta_json);
+  } else {
+    w->abort(false);
+  }
+  delete w;
+  return rc;
+}
+
+int Store::remove(const std::string &key) {
+  if (!is_safe_key(key)) return -EINVAL;
+  std::string old_meta = meta(key);
+  if (!old_meta.empty()) drop_digest_ref(key, old_meta);
+  int rc = 0;
+  if (::unlink(obj_path(key).c_str()) != 0 && errno != ENOENT) rc = -errno;
+  ::unlink(meta_path(key).c_str());
+  ::unlink(part_path(key).c_str());
+  {
+    std::lock_guard<std::mutex> g(fd_mu_);
+    auto it = fd_cache_.find(key);
+    if (it != fd_cache_.end()) {
+      ::close(it->second);
+      fd_cache_.erase(it);
+    }
+  }
+  invalidate_index();
+  return rc;
+}
+
+int Store::materialize(const std::string &key, const std::string &digest,
+                       const std::string &meta_json) {
+  if (!is_safe_key(key) || !is_hex_digest(digest)) return -EINVAL;
+  if (!has_digest(digest)) return -ENOENT;
+  // link to a temp name then rename — concurrent materialize of one key
+  // must not fail halfway with a dangling link
+  std::string tmp = obj_path(key) + ".lnk";
+  ::unlink(tmp.c_str());
+  if (::link(digest_path(digest).c_str(), tmp.c_str()) != 0) return -errno;
+  std::string mtmp = meta_path(key) + ".tmp";
+  int mfd = ::open(mtmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+  if (mfd < 0) {
+    ::unlink(tmp.c_str());
+    return -errno;
+  }
+  ::write(mfd, meta_json.data(), meta_json.size());
+  ::fsync(mfd);
+  ::close(mfd);
+  if (::rename(mtmp.c_str(), meta_path(key).c_str()) != 0 ||
+      ::rename(tmp.c_str(), obj_path(key).c_str()) != 0) {
+    int e = -errno;
+    ::unlink(tmp.c_str());
+    return e;
+  }
+  invalidate_index();
+  return 0;
+}
+
+void Store::invalidate_index() {
+  std::lock_guard<std::mutex> g(index_mu_);
+  index_mtime_ns_ = -1;
+}
+
+static int64_t dir_mtime_ns(const std::string &path) {
+  struct stat st;
+  if (::stat(path.c_str(), &st) != 0) return -2;
+  return static_cast<int64_t>(st.st_mtim.tv_sec) * 1000000000 +
+         st.st_mtim.tv_nsec;
+}
+
+std::string Store::index_json() {
+  std::string dir = root_ + "/objects";
+  int64_t now_mtime = dir_mtime_ns(dir);
+  {
+    std::lock_guard<std::mutex> g(index_mu_);
+    // revalidate by directory mtime so foreign-process writes show up
+    if (index_mtime_ns_ >= 0 && index_mtime_ns_ == now_mtime)
+      return index_cache_;
+  }
+  std::string out = "{\"keys\":[";
+  bool first = true;
+  DIR *d = ::opendir(dir.c_str());
+  if (d) {
+    struct dirent *e;
+    while ((e = ::readdir(d)) != nullptr) {
+      std::string name = e->d_name;
+      if (name.size() < 1 || name == "." || name == "..") continue;
+      if (name.size() > 5 && name.compare(name.size() - 5, 5, ".meta") == 0)
+        continue;
+      if (name.size() > 4 && name.compare(name.size() - 4, 4, ".tmp") == 0)
+        continue;
+      if (name.size() > 4 && name.compare(name.size() - 4, 4, ".lnk") == 0)
+        continue;
+      std::string m = meta(name);
+      if (meta_is_private(m)) continue;  // auth-scoped: never advertised
+      int64_t sz = size(name);
+      if (sz < 0) continue;
+      if (!first) out += ",";
+      first = false;
+      out += "{\"key\":\"" + name + "\",\"size\":" + std::to_string(sz);
+      std::string digest = meta_digest(m);
+      out += ",\"sha256\":\"" + digest + "\"}";
+    }
+    ::closedir(d);
+  }
+  out += "]}";
+  std::lock_guard<std::mutex> g(index_mu_);
+  index_cache_ = out;
+  index_mtime_ns_ = now_mtime;
+  return out;
+}
+
+std::string Store::list_keys() {
+  std::string out;
+  DIR *d = ::opendir((root_ + "/objects").c_str());
+  if (d) {
+    struct dirent *e;
+    while ((e = ::readdir(d)) != nullptr) {
+      std::string name = e->d_name;
+      if (name == "." || name == "..") continue;
+      if (name.size() > 5 && name.compare(name.size() - 5, 5, ".meta") == 0)
+        continue;
+      if (name.size() > 4 && (name.compare(name.size() - 4, 4, ".tmp") == 0 ||
+                              name.compare(name.size() - 4, 4, ".lnk") == 0))
+        continue;
+      out += name + "\n";
+    }
+    ::closedir(d);
+  }
+  return out;
+}
+
+}  // namespace dm
+
+// ----------------------------------------------------------------- C API
+
+extern "C" {
+
+static void dm_copy_err(const std::string &err, char *buf, int len) {
+  if (!buf || len <= 0) return;
+  int n = static_cast<int>(err.size());
+  if (n >= len) n = len - 1;
+  ::memcpy(buf, err.data(), static_cast<size_t>(n));
+  buf[n] = 0;
+}
+
+void *dm_store_open(const char *root, char *errbuf, int errlen) {
+  std::string err;
+  dm::Store *s = dm::Store::open(root ? root : "", &err);
+  if (!s) dm_copy_err(err, errbuf, errlen);
+  return s;
+}
+
+void dm_store_close(void *h) { delete static_cast<dm::Store *>(h); }
+
+int dm_store_has(void *h, const char *key) {
+  return static_cast<dm::Store *>(h)->has(key ? key : "") ? 1 : 0;
+}
+
+int64_t dm_store_size(void *h, const char *key) {
+  return static_cast<dm::Store *>(h)->size(key ? key : "");
+}
+
+int64_t dm_store_partial_size(void *h, const char *key) {
+  return static_cast<dm::Store *>(h)->partial_size(key ? key : "");
+}
+
+int dm_store_meta(void *h, const char *key, char *buf, int buflen) {
+  std::string m = static_cast<dm::Store *>(h)->meta(key ? key : "");
+  if (m.empty()) return -1;
+  if (buf && buflen > 0) {
+    int n = static_cast<int>(m.size());
+    if (n >= buflen) n = buflen - 1;
+    ::memcpy(buf, m.data(), static_cast<size_t>(n));
+    buf[n] = 0;
+  }
+  return static_cast<int>(m.size());
+}
+
+int64_t dm_store_pread(void *h, const char *key, void *buf, int64_t len,
+                       int64_t off) {
+  return static_cast<dm::Store *>(h)->pread(key ? key : "", buf, len, off);
+}
+
+int dm_store_put(void *h, const char *key, const void *body, int64_t len,
+                 const char *meta_json, char *digest_out) {
+  return static_cast<dm::Store *>(h)->put(key ? key : "", body, len,
+                                          meta_json ? meta_json : "{}",
+                                          digest_out);
+}
+
+int dm_store_remove(void *h, const char *key) {
+  return static_cast<dm::Store *>(h)->remove(key ? key : "");
+}
+
+int dm_store_has_digest(void *h, const char *digest) {
+  return static_cast<dm::Store *>(h)->has_digest(digest ? digest : "") ? 1 : 0;
+}
+
+int dm_store_materialize(void *h, const char *key, const char *digest,
+                         const char *meta_json) {
+  return static_cast<dm::Store *>(h)->materialize(
+      key ? key : "", digest ? digest : "", meta_json ? meta_json : "{}");
+}
+
+void *dm_store_begin(void *h, const char *key, int resume, char *errbuf,
+                     int errlen) {
+  std::string err;
+  dm::Writer *w = static_cast<dm::Store *>(h)->begin(key ? key : "",
+                                                     resume != 0, &err);
+  if (!w) dm_copy_err(err, errbuf, errlen);
+  return w;
+}
+
+void *dm_store_begin_ranged(void *h, const char *key, int64_t total,
+                            char *errbuf, int errlen) {
+  std::string err;
+  dm::RangeWriter *w = static_cast<dm::Store *>(h)->begin_ranged(
+      key ? key : "", total, &err);
+  if (!w) dm_copy_err(err, errbuf, errlen);
+  return w;
+}
+
+int dm_store_index_json(void *h, char *buf, int buflen) {
+  std::string j = static_cast<dm::Store *>(h)->index_json();
+  if (buf && buflen > 0) {
+    int n = static_cast<int>(j.size());
+    if (n >= buflen) n = buflen - 1;
+    ::memcpy(buf, j.data(), static_cast<size_t>(n));
+    buf[n] = 0;
+  }
+  return static_cast<int>(j.size());
+}
+
+int dm_store_list(void *h, char *buf, int buflen) {
+  std::string j = static_cast<dm::Store *>(h)->list_keys();
+  if (buf && buflen > 0) {
+    int n = static_cast<int>(j.size());
+    if (n >= buflen) n = buflen - 1;
+    ::memcpy(buf, j.data(), static_cast<size_t>(n));
+    buf[n] = 0;
+  }
+  return static_cast<int>(j.size());
+}
+
+void dm_key_for_uri(const char *uri, char *out17) {
+  std::string k = dm::key_for_uri(uri ? uri : "");
+  ::memcpy(out17, k.c_str(), k.size() + 1);
+}
+
+// -- streaming writer
+
+int dm_writer_append(void *w, const void *buf, int64_t len) {
+  return static_cast<dm::Writer *>(w)->append(buf, len);
+}
+
+int64_t dm_writer_offset(void *w) {
+  return static_cast<dm::Writer *>(w)->offset();
+}
+
+void dm_writer_digest(void *w, char *out65) {
+  std::string d = static_cast<dm::Writer *>(w)->digest();
+  ::memcpy(out65, d.c_str(), d.size() + 1);
+}
+
+int dm_writer_commit(void *w, const char *meta_json) {
+  dm::Writer *wr = static_cast<dm::Writer *>(w);
+  int rc = wr->commit(meta_json ? meta_json : "{}");
+  delete wr;
+  return rc;
+}
+
+void dm_writer_abort(void *w, int keep_partial) {
+  dm::Writer *wr = static_cast<dm::Writer *>(w);
+  wr->abort(keep_partial != 0);
+  delete wr;
+}
+
+// -- positional (parallel-range) writer
+
+int dm_rw_pwrite(void *w, const void *buf, int64_t len, int64_t off) {
+  return static_cast<dm::RangeWriter *>(w)->pwrite_at(buf, len, off);
+}
+
+int64_t dm_rw_written(void *w) {
+  return static_cast<dm::RangeWriter *>(w)->written();
+}
+
+int dm_rw_commit(void *w, const char *meta_json, const char *expected_digest,
+                 char *digest_out) {
+  dm::RangeWriter *rw = static_cast<dm::RangeWriter *>(w);
+  int rc = rw->commit(meta_json ? meta_json : "{}",
+                      expected_digest ? expected_digest : "", digest_out);
+  delete rw;
+  return rc;
+}
+
+void dm_rw_abort(void *w, int keep_partial) {
+  dm::RangeWriter *rw = static_cast<dm::RangeWriter *>(w);
+  rw->abort(keep_partial != 0);
+  delete rw;
+}
+
+}  // extern "C"

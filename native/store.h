@@ -1,0 +1,180 @@
+// Content-addressed chunk store — the C++ layer under demodel_tpu.store.
+//
+// Data model parity with the legacy-Rust cache (reference
+// CONTRIBUTING.md:53-154): per-URI 16-hex keys, body bytes exactly as
+// transferred, JSON `.meta` header sidecar. Beyond the reference: resumable
+// partials (`partial/`), positional parallel writes (RangeWriter),
+// content-address hardlinks (`digests/<sha256>`), an in-memory index for
+// /peer/index, and a small read-fd cache for the serving hot path.
+//
+// Layout under root:
+//   objects/<key>        committed body bytes
+//   objects/<key>.meta   JSON sidecar (uri, sha256, size, headers, ...)
+//   partial/<key>        in-progress/resumable writes
+//   digests/<sha256>     hardlink to an objects/<key> holding those bytes
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <unordered_map>
+
+namespace dm {
+
+class Store;
+
+// 16-hex key: first 8 bytes of sha256(uri) — mirrored by the Python
+// key_for_uri (tests/test_store.py::test_key_matches_native).
+std::string key_for_uri(const std::string &uri);
+
+// minimal flat-JSON string-field scan (meta sidecars are written by
+// json.dumps / our own composer — no nesting for the fields we need)
+std::string meta_scan(const std::string &meta, const char *name);
+
+// Streaming appender onto partial/<key>; commit hashes-as-it-goes and
+// publishes atomically. One live writer per key (store enforces the guard).
+class Writer {
+ public:
+  Writer(Store *store, std::string key, int fd, int64_t offset, void *sha);
+  ~Writer();
+  Writer(const Writer &) = delete;
+  Writer &operator=(const Writer &) = delete;
+
+  int append(const void *buf, int64_t len);       // 0 or -errno
+  std::string digest();                           // running sha256 (peekable)
+  int commit(const std::string &meta_json);       // 0 or -errno
+  int abort(bool keep_partial);
+  int64_t offset() const { return offset_; }
+
+ private:
+  friend class Store;
+  Store *store_;
+  std::string key_;
+  int fd_;
+  int64_t offset_;
+  void *sha_;  // Sha256* (opaque here: sha256.h stays out of this header)
+  bool done_ = false;
+};
+
+// Positional writer over a preallocated partial of known total size —
+// parallel range fetches write disjoint slices from N threads; commit
+// verifies coverage, hashes once sequentially, optionally checks an
+// expected digest (mismatch → -EBADMSG), and publishes atomically.
+class RangeWriter {
+ public:
+  RangeWriter(Store *store, std::string key, int fd, int64_t total);
+  ~RangeWriter();
+  RangeWriter(const RangeWriter &) = delete;
+  RangeWriter &operator=(const RangeWriter &) = delete;
+
+  int pwrite_at(const void *buf, int64_t len, int64_t off);  // 0 or -errno
+  int64_t written() const;  // distinct covered bytes
+  int commit(const std::string &meta_json, const std::string &expected_digest,
+             char *digest_out /* 65 bytes, may be null */);
+  int abort(bool keep_partial);
+
+ private:
+  friend class Store;
+  Store *store_;
+  std::string key_;
+  int fd_;
+  int64_t total_;
+  bool done_ = false;
+  mutable std::mutex mu_;
+  std::map<int64_t, int64_t> cov_;  // start → end, disjoint, sorted
+};
+
+class Store {
+ public:
+  static Store *open(const std::string &root, std::string *err);
+  ~Store();
+  Store(const Store &) = delete;
+  Store &operator=(const Store &) = delete;
+
+  const std::string &root() const { return root_; }
+
+  // -- queries
+  bool has(const std::string &key);
+  int64_t size(const std::string &key);           // -1 when absent
+  int64_t partial_size(const std::string &key);   // 0 when no partial
+  std::string meta(const std::string &key);       // "" when absent
+  bool is_private(const std::string &key);        // meta carries auth_scope
+  bool has_digest(const std::string &digest);
+  // JSON {"keys":[{"key":...,"size":N,"sha256":...}, ...]} of PUBLIC
+  // objects — the /peer/index body. Served from an in-memory index that
+  // revalidates against the objects directory mtime, so writes by other
+  // processes sharing the root become visible.
+  std::string index_json();
+  // newline-separated keys (all, including private) — Python Store.list()
+  std::string list_keys();
+
+  // -- reads
+  int64_t pread(const std::string &key, void *buf, int64_t len, int64_t off);
+  // caller-owned fd (a dup of the cached per-key fd — callers close it);
+  // -1 when the object is absent
+  int open_read_fd(const std::string &key);
+
+  // -- writes
+  Writer *begin(const std::string &key, bool resume, std::string *err);
+  RangeWriter *begin_ranged(const std::string &key, int64_t total,
+                            std::string *err);
+  int put(const std::string &key, const void *body, int64_t len,
+          const std::string &meta_json, char *digest_out /* 65B, nullable */);
+  int remove(const std::string &key);
+  // publish `digest`'s bytes (already in the store under another key) as
+  // `key` via hardlink + fresh meta — content-address dedup, zero copy
+  int materialize(const std::string &key, const std::string &digest,
+                  const std::string &meta_json);
+
+  // -- paths (used by writers and the proxy's fill-attach reader)
+  std::string obj_path(const std::string &key) const;
+  std::string meta_path(const std::string &key) const;
+  std::string part_path(const std::string &key) const;
+  std::string digest_path(const std::string &digest) const;
+
+  // -- meta helpers
+  static bool meta_is_private(const std::string &meta_json);
+  static std::string meta_digest(const std::string &meta_json);
+
+  // -- writer-guard plumbing (Writer/RangeWriter call these)
+  int publish(const std::string &key, const std::string &meta_json,
+              const std::string &digest);
+  void finish_writer(const std::string &key);
+
+ private:
+  explicit Store(std::string root) : root_(std::move(root)) {}
+  bool claim_writer(const std::string &key);
+  void drop_digest_ref(const std::string &key, const std::string &old_meta);
+  void invalidate_index();
+
+  std::string root_;
+
+  std::mutex writers_mu_;
+  std::set<std::string> active_writers_;
+
+  std::mutex fd_mu_;
+  std::unordered_map<std::string, int> fd_cache_;  // key → open O_RDONLY fd
+
+  std::mutex index_mu_;
+  std::string index_cache_;
+  int64_t index_mtime_ns_ = -1;  // objects/ dir mtime when cache was built
+};
+
+// peer DCN fetch (implemented in proxy.cc — shares Conn/http plumbing)
+int64_t peer_fetch(Store *store, const std::string &host, int port,
+                   const std::string &path, const std::string &key,
+                   const std::string &expected_digest,
+                   const std::string &meta_json, std::string *err);
+int64_t peer_fetch_parallel(Store *store, const std::string &host, int port,
+                            const std::string &path, const std::string &key,
+                            int64_t total, int streams,
+                            const std::string &expected_digest,
+                            const std::string &meta_json, std::string *err);
+int64_t peer_fetch_into(const std::string &host, int port,
+                        const std::string &path, int64_t total, int streams,
+                        const std::string &expected_digest, char *out,
+                        std::string *err);
+
+}  // namespace dm

@@ -1,0 +1,44 @@
+"""Test harness: force an 8-virtual-device CPU platform BEFORE jax
+initializes (SURVEY.md §4/§7 — NamedSharding placement without TPUs).
+
+A sitecustomize in this image registers the real TPU backend before any
+user code runs, so env vars alone don't switch platforms —
+``jax.config.update`` after import is the only reliable path.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+# append (not clobber) the virtual device count to any existing XLA flags
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:  # backend already up (re-entrant runs) — best effort
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def mesh8():
+    from demodel_tpu.parallel import make_mesh
+
+    return make_mesh(8)
+
+
+@pytest.fixture()
+def tmp_dirs(tmp_path):
+    """(data_dir, cache_dir) pair for config-dependent components."""
+    data = tmp_path / "data"
+    cache = tmp_path / "cache"
+    data.mkdir()
+    cache.mkdir()
+    return data, cache

@@ -1,0 +1,271 @@
+"""In-process fake HuggingFace Hub + Ollama registries (SURVEY.md §4: the
+rebuild's substitute for the reference's manual live-registry runbook).
+
+The Ollama manifest fixture follows the golden schema documented in the
+reference cache walkthrough (CONTRIBUTING.md:128-153).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler
+
+import numpy as np
+
+from demodel_tpu.formats import safetensors as st
+
+
+def build_hf_repo(seed: int = 0, n_shards: int = 1, rows: int = 64) -> dict:
+    """repo: filename → bytes. Weights split across n_shards safetensors."""
+    rng = np.random.default_rng(seed)
+    files: dict[str, bytes] = {}
+    config = {
+        "model_type": "llama", "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 8, "num_key_value_heads": 4,
+        "intermediate_size": 128, "vocab_size": 256,
+    }
+    files["config.json"] = json.dumps(config).encode()
+    files["tokenizer.json"] = json.dumps({"version": "1.0", "model": {}}).encode()
+    weight_map = {}
+    for i in range(n_shards):
+        tensors = {
+            f"layer.{i}.w": rng.standard_normal((rows, 64), np.float32),
+            f"layer.{i}.b": rng.standard_normal((64,), np.float32),
+        }
+        fname = (
+            "model.safetensors" if n_shards == 1
+            else f"model-{i + 1:05d}-of-{n_shards:05d}.safetensors"
+        )
+        files[fname] = st.serialize(tensors)
+        for t in tensors:
+            weight_map[t] = fname
+    if n_shards > 1:
+        files["model.safetensors.index.json"] = json.dumps(
+            {"metadata": {"total_size": sum(len(v) for k, v in files.items()
+                                            if k.endswith(".safetensors"))},
+             "weight_map": weight_map}
+        ).encode()
+    return files
+
+
+def make_hf_handler(repos: dict[str, dict[str, bytes]], commit: str = "c0ffee" * 6 + "c0ff",
+                    signed_cdn: bool = False):
+    """Handler class over {repo_id: {filename: bytes}}; LFS-style 302→CDN for
+    .safetensors, direct 200 for small files; CDN supports Range.
+
+    ``signed_cdn`` mimics the real huggingface.co CDN: every redirect gets a
+    FRESH signature query string and the CDN rejects unsigned requests — so
+    URI-keyed caching alone can never hit on a re-pull (the proxy must dedup
+    via the X-Linked-Etag digest hint)."""
+
+    counts: dict[str, int] = {}
+    sig_counter = [0]
+    lock = threading.Lock()
+    # digests precomputed once: a real hub serves ETags from metadata; the
+    # fixture must not charge per-request sha256 of multi-GB blobs to the
+    # client under test
+    digests = {rid: {fn: hashlib.sha256(body).hexdigest()
+                     for fn, body in files.items()}
+               for rid, files in repos.items()}
+    by_digest = {rid: {sha: fn for fn, sha in m.items()}
+                 for rid, m in digests.items()}
+
+    class FakeHFHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        request_counts = counts
+
+        def log_message(self, *a):
+            pass
+
+        def _count(self, bucket: str):
+            with lock:
+                counts[bucket] = counts.get(bucket, 0) + 1
+
+        def _send(self, status, body: bytes, ctype="application/json", extra=None):
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if self.command != "HEAD":
+                self.wfile.write(body)
+
+        def do_HEAD(self):
+            self.do_GET()
+
+        def do_GET(self):  # noqa: C901
+            path = self.path.split("?", 1)[0]  # hub clients append ?expand=…
+            m = re.match(r"^/api/models/(.+?)/revision/([^/]+)$", path)
+            if m:
+                repo_id, rev = m.group(1), m.group(2)
+                self._count("api")
+                if repo_id not in repos:
+                    self._send(404, b'{"error":"RepoNotFound"}')
+                    return
+                siblings = [{"rfilename": f} for f in sorted(repos[repo_id])]
+                self._send(200, json.dumps(
+                    {"sha": commit, "siblings": siblings, "id": repo_id}
+                ).encode())
+                return
+
+            m = re.match(r"^/(.+?)/resolve/([^/]+)/(.+)$", path)
+            if m:
+                repo_id, rev, fname = m.groups()
+                # HEAD probes are metadata-only (the digest probe / hub
+                # metadata flow) — count separately from byte-moving GETs
+                prefix = "head-" if self.command == "HEAD" else ""
+                self._count(f"{prefix}resolve:{fname}")
+                body = repos.get(repo_id, {}).get(fname)
+                if body is None:
+                    self._send(404, b'{"error":"EntryNotFound"}')
+                    return
+                sha = digests[repo_id][fname]
+                if fname.endswith(".safetensors") or fname.endswith(".gguf"):
+                    # LFS blob → 302 to CDN (the huggingface.co behavior);
+                    # X-Linked-{Etag,Size} are what get_hf_file_metadata
+                    # reads. The Location must be ABSOLUTE: the real hub
+                    # redirects cross-host (cdn-lfs.huggingface.co) and
+                    # huggingface_hub only follows *relative* redirects
+                    # during its metadata HEAD — an absolute one makes it
+                    # stop at the 302 and read the X-Linked-* headers, which
+                    # is the flow the proxy must preserve.
+                    import ssl as _ssl
+
+                    scheme = ("https" if isinstance(self.connection,
+                                                    _ssl.SSLSocket) else "http")
+                    host = self.headers.get("Host", "127.0.0.1")
+                    sig = ""
+                    if signed_cdn:
+                        with lock:
+                            sig_counter[0] += 1
+                        sig = f"?X-Sig={sig_counter[0]:08d}&Expires=9999999999"
+                    self._send(302, b"", extra={
+                        "Location": f"{scheme}://{host}/cdn/{repo_id}/{sha}{sig}",
+                        "X-Linked-Etag": f'"{sha}"',
+                        "X-Linked-Size": str(len(body)),
+                        "X-Repo-Commit": commit,
+                        "Accept-Ranges": "bytes",
+                    })
+                else:
+                    self._send(200, body, ctype="application/octet-stream",
+                               extra={"ETag": f'"{sha}"', "X-Repo-Commit": commit,
+                                      "Accept-Ranges": "bytes"})
+                return
+
+            m = re.match(r"^/cdn/(.+?)/([0-9a-f]{64})$", path)
+            if m:
+                repo_id, sha = m.groups()
+                if signed_cdn and "X-Sig=" not in self.path:
+                    self._count("cdn-unsigned")
+                    self._send(403, b"unsigned CDN request")
+                    return
+                self._count("cdn")
+                fn = by_digest.get(repo_id, {}).get(sha)
+                body = repos.get(repo_id, {}).get(fn) if fn else None
+                if body is None:
+                    self._send(404, b"")
+                    return
+                rng = self.headers.get("Range")
+                if rng and rng.startswith("bytes="):
+                    start_s, _, end_s = rng[6:].partition("-")
+                    start = int(start_s)
+                    end = int(end_s) if end_s else len(body) - 1
+                    part = body[start : end + 1]
+                    self._send(206, part, ctype="application/octet-stream", extra={
+                        "ETag": f'"{sha}"',
+                        "Content-Range": f"bytes {start}-{start + len(part) - 1}/{len(body)}",
+                    })
+                else:
+                    self._send(200, body, ctype="application/octet-stream",
+                               extra={"ETag": f'"{sha}"'})
+                return
+
+            self._send(404, b'{"error":"not found"}')
+
+    return FakeHFHandler
+
+
+def build_ollama_model(seed: int = 1, blob_kb: int = 64) -> tuple[dict, dict[str, bytes]]:
+    """(manifest, blobs-by-digest) for a fake Ollama model, golden-schema
+    shaped (CONTRIBUTING.md:128-153)."""
+    rng = np.random.default_rng(seed)
+    model_blob = rng.bytes(blob_kb * 1024)  # stands in for the GGUF layer
+    params_blob = json.dumps({"num_ctx": 2048}).encode()
+    license_blob = b"Apache-2.0"
+    config_blob = json.dumps({"model_format": "gguf", "model_type": "test"}).encode()
+
+    def dig(b: bytes) -> str:
+        return "sha256:" + hashlib.sha256(b).hexdigest()
+
+    blobs = {dig(b): b for b in (model_blob, params_blob, license_blob, config_blob)}
+    manifest = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.docker.distribution.manifest.v2+json",
+        "config": {
+            "mediaType": "application/vnd.docker.container.image.v1+json",
+            "digest": dig(config_blob), "size": len(config_blob),
+        },
+        "layers": [
+            {"mediaType": "application/vnd.ollama.image.model",
+             "digest": dig(model_blob), "size": len(model_blob)},
+            {"mediaType": "application/vnd.ollama.image.license",
+             "digest": dig(license_blob), "size": len(license_blob)},
+            {"mediaType": "application/vnd.ollama.image.params",
+             "digest": dig(params_blob), "size": len(params_blob)},
+        ],
+    }
+    return manifest, blobs
+
+
+def make_ollama_handler(models: dict[str, dict], blobs: dict[str, bytes]):
+    """Handler over {name:tag → manifest} + {digest → bytes}."""
+
+    counts: dict[str, int] = {}
+    lock = threading.Lock()
+
+    class FakeOllamaHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        request_counts = counts
+
+        def log_message(self, *a):
+            pass
+
+        def _count(self, bucket: str):
+            with lock:
+                counts[bucket] = counts.get(bucket, 0) + 1
+
+        def _send(self, status, body: bytes, ctype="application/json"):
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Docker-Distribution-Api-Version", "registry/2.0")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            m = re.match(r"^/v2/(.+?)/manifests/([^/]+)$", self.path)
+            if m:
+                key = f"{m.group(1)}:{m.group(2)}"
+                self._count("manifest")
+                if key not in models:
+                    self._send(404, b'{"errors":[{"code":"MANIFEST_UNKNOWN"}]}')
+                    return
+                self._send(200, json.dumps(models[key]).encode(),
+                           ctype="application/vnd.docker.distribution.manifest.v2+json")
+                return
+            m = re.match(r"^/v2/(.+?)/blobs/(sha256:[0-9a-f]{64})$", self.path)
+            if m:
+                self._count("blob")
+                body = blobs.get(m.group(2))
+                if body is None:
+                    self._send(404, b'{"errors":[{"code":"BLOB_UNKNOWN"}]}')
+                    return
+                self._send(200, body, ctype="application/octet-stream")
+                return
+            self._send(404, b"{}")
+
+    return FakeOllamaHandler

