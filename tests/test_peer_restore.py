@@ -730,8 +730,10 @@ def test_commit_failure_omits_file_from_manifest(tmp_path, mesh8, monkeypatch):
         try:
             mkey = delivery.manifest_key("hf", "org/cf")
             rec = json.loads(cold_store.get(mkey))
-            assert poisoned[0] not in {f["key"] for f in rec["files"]}
-            assert any(f["name"] == "config.json" for f in rec["files"])
+            kept = {f["key"] for f in rec["files"]}
+            assert poisoned[0] not in kept
+            # every file except the poisoned one survives in the manifest
+            assert kept == {f["key"] for f in report["files"]} - {poisoned[0]}
         finally:
             cold_store.close()
 
