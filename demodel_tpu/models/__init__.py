@@ -1,0 +1,4 @@
+from demodel_tpu.models import bert, gpt2, llama, moe
+from demodel_tpu.models.auto import model_from_pull
+
+__all__ = ["bert", "gpt2", "llama", "moe", "model_from_pull"]

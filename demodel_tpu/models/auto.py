@@ -1,0 +1,81 @@
+"""Auto dispatch: pulled checkpoint → (forward_fn, params, config).
+
+Closes the delivery loop: ``pull_to_hbm`` lands sharded tensors, this maps
+them onto a model family by the pulled ``config.json``'s ``model_type`` and
+returns a ready forward function — a pulled model is runnable in one call.
+Unknown architectures and config features this stack does not implement
+(e.g. rope scaling) are rejected loudly rather than silently mis-executed.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+
+from demodel_tpu.models import bert as bert_mod
+from demodel_tpu.models import gpt2 as gpt2_mod
+from demodel_tpu.models import llama as llama_mod
+from demodel_tpu.models.hf_loader import (
+    load_bert_params,
+    load_gpt2_params,
+    load_llama_params,
+)
+from demodel_tpu.utils.logging import get_logger
+
+log = get_logger("models.auto")
+
+#: config fields whose presence (non-null/non-default) changes numerics in
+#: ways this stack does not implement — refuse rather than drift
+_UNSUPPORTED = ("rope_scaling", "sliding_window", "attention_bias")
+
+
+def _check_supported(config: dict) -> None:
+    for fld in _UNSUPPORTED:
+        v = config.get(fld)
+        if v not in (None, False):
+            raise ValueError(
+                f"config field {fld}={v!r} is not supported by this stack")
+
+
+def model_from_pull(store, report, mesh=None, placement=None):
+    """(forward_fn, params, cfg) from a pulled snapshot.
+
+    ``placement`` (a delivered :class:`~demodel_tpu.sink.hbm.Placement`)
+    supplies the weight arrays when given; otherwise weights are delivered
+    from the store now under the default plan.
+    """
+    files = report["files"] if isinstance(report, dict) else [
+        vars(f) for f in report.files]
+    cfg_file = next((f for f in files if f["name"] == "config.json"), None)
+    if cfg_file is None:
+        raise ValueError("pulled snapshot has no config.json")
+    config = json.loads(bytes(store.get(cfg_file["key"])).decode())
+    model_type = config.get("model_type")
+
+    if placement is None:
+        from demodel_tpu.sink.hbm import deliver_report_to_hbm
+
+        placement = deliver_report_to_hbm(store, report, mesh=mesh)
+    weights = placement.arrays
+
+    if model_type == "llama":
+        _check_supported(config)
+        cfg = llama_mod.LlamaConfig.from_hf(config)
+        params = load_llama_params(weights, cfg)
+        fn = functools.partial(llama_mod.forward, cfg=cfg, mesh=mesh)
+    elif model_type == "gpt2":
+        _check_supported(config)
+        cfg = gpt2_mod.GPT2Config.from_hf(config)
+        params = load_gpt2_params(weights, cfg)
+        fn = functools.partial(gpt2_mod.forward, cfg=cfg, mesh=mesh)
+    elif model_type == "bert":
+        _check_supported(config)
+        cfg = bert_mod.BertConfig.from_hf(config)
+        params = load_bert_params(weights, cfg)
+        fn = functools.partial(bert_mod.encode, cfg=cfg, mesh=mesh)
+    else:
+        raise ValueError(f"unsupported model_type {model_type!r} "
+                         "(supported: llama, gpt2, bert)")
+    log.info("auto: built %s from pulled snapshot (%d tensors)",
+             model_type, len(weights))
+    return fn, params, cfg

@@ -1,0 +1,124 @@
+"""HF-checkpoint → params-tree mapping for the model families.
+
+Consumes a flat ``{tensor_name: array}`` (a sink :class:`Placement`'s
+arrays, or host numpy) holding a ``transformers``-layout state dict and
+rebuilds each family's params pytree. torch ``nn.Linear`` stores
+``[out, in]`` — those transpose on the way in; GPT-2's Conv1D already
+stores ``[in, out]`` and loads verbatim. Optional name prefixes
+("model.", "transformer.", "bert.") are stripped automatically.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from demodel_tpu.models.bert import BertConfig
+from demodel_tpu.models.gpt2 import GPT2Config
+from demodel_tpu.models.llama import LlamaConfig
+
+_PREFIXES = ("", "model.", "transformer.", "bert.")
+
+
+class _Weights:
+    def __init__(self, weights: dict):
+        self.w = weights
+
+    def get(self, name: str, transpose: bool = False):
+        for p in _PREFIXES:
+            if p + name in self.w:
+                arr = jnp.asarray(np.asarray(self.w[p + name]))
+                return arr.T if transpose else arr
+        raise KeyError(f"checkpoint has no tensor {name!r} "
+                       f"(tried prefixes {_PREFIXES})")
+
+    def has(self, name: str) -> bool:
+        return any(p + name in self.w for p in _PREFIXES)
+
+
+def load_llama_params(weights: dict, cfg: LlamaConfig) -> dict:
+    w = _Weights(weights)
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        pre = f"layers.{i}."
+        layers.append({
+            "attn_norm": w.get(pre + "input_layernorm.weight"),
+            "q_proj": w.get(pre + "self_attn.q_proj.weight", transpose=True),
+            "k_proj": w.get(pre + "self_attn.k_proj.weight", transpose=True),
+            "v_proj": w.get(pre + "self_attn.v_proj.weight", transpose=True),
+            "o_proj": w.get(pre + "self_attn.o_proj.weight", transpose=True),
+            "mlp_norm": w.get(pre + "post_attention_layernorm.weight"),
+            "gate_proj": w.get(pre + "mlp.gate_proj.weight", transpose=True),
+            "up_proj": w.get(pre + "mlp.up_proj.weight", transpose=True),
+            "down_proj": w.get(pre + "mlp.down_proj.weight", transpose=True),
+        })
+    embed = w.get("embed_tokens.weight")
+    if w.has("lm_head.weight"):
+        head = w.get("lm_head.weight", transpose=True)
+    else:  # tied embeddings
+        head = embed.T
+    return {
+        "embed": embed,
+        "layers": layers,
+        "final_norm": w.get("norm.weight"),
+        "lm_head": head,
+    }
+
+
+def load_gpt2_params(weights: dict, cfg: GPT2Config) -> dict:
+    w = _Weights(weights)
+    layers = []
+    for i in range(cfg.n_layer):
+        pre = f"h.{i}."
+        layers.append({
+            "ln_1": {"w": w.get(pre + "ln_1.weight"),
+                     "b": w.get(pre + "ln_1.bias")},
+            "c_attn": {"w": w.get(pre + "attn.c_attn.weight"),
+                       "b": w.get(pre + "attn.c_attn.bias")},
+            "c_proj": {"w": w.get(pre + "attn.c_proj.weight"),
+                       "b": w.get(pre + "attn.c_proj.bias")},
+            "ln_2": {"w": w.get(pre + "ln_2.weight"),
+                     "b": w.get(pre + "ln_2.bias")},
+            "mlp_fc": {"w": w.get(pre + "mlp.c_fc.weight"),
+                       "b": w.get(pre + "mlp.c_fc.bias")},
+            "mlp_proj": {"w": w.get(pre + "mlp.c_proj.weight"),
+                         "b": w.get(pre + "mlp.c_proj.bias")},
+        })
+    return {
+        "wte": w.get("wte.weight"),
+        "wpe": w.get("wpe.weight"),
+        "layers": layers,
+        "ln_f": {"w": w.get("ln_f.weight"), "b": w.get("ln_f.bias")},
+    }
+
+
+def load_bert_params(weights: dict, cfg: BertConfig) -> dict:
+    w = _Weights(weights)
+
+    def lin(name):
+        return {"w": w.get(name + ".weight", transpose=True),
+                "b": w.get(name + ".bias")}
+
+    def ln(name):
+        return {"w": w.get(name + ".weight"), "b": w.get(name + ".bias")}
+
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        pre = f"encoder.layer.{i}."
+        layers.append({
+            "q": lin(pre + "attention.self.query"),
+            "k": lin(pre + "attention.self.key"),
+            "v": lin(pre + "attention.self.value"),
+            "attn_out": lin(pre + "attention.output.dense"),
+            "attn_ln": ln(pre + "attention.output.LayerNorm"),
+            "inter": lin(pre + "intermediate.dense"),
+            "out": lin(pre + "output.dense"),
+            "out_ln": ln(pre + "output.LayerNorm"),
+        })
+    return {
+        "word_emb": w.get("embeddings.word_embeddings.weight"),
+        "pos_emb": w.get("embeddings.position_embeddings.weight"),
+        "type_emb": w.get("embeddings.token_type_embeddings.weight"),
+        "emb_ln": ln("embeddings.LayerNorm"),
+        "layers": layers,
+    }

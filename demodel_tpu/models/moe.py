@@ -1,0 +1,155 @@
+"""Mixture-of-Experts LM with expert parallelism over an ``ep`` mesh axis.
+
+Top-1 token-choice routing with a capacity factor: overflowing tokens are
+dropped (contribute zero), the standard static-shape TPU formulation — the
+dispatch/combine are dense one-hot einsums that XLA lays out as all-to-alls
+when the expert axis is sharded over ``ep``. Everything is shape-static and
+jit-safe; no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from demodel_tpu.models.common import rms_norm
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 256
+    hidden_size: int = 64
+    intermediate_size: int = 128
+    num_layers: int = 2
+    num_experts: int = 4
+    capacity_factor: float = 1.25
+    dtype: str = "float32"
+
+    @classmethod
+    def tiny(cls) -> "MoEConfig":
+        return cls()
+
+
+def init_params(key, cfg: MoEConfig) -> dict:
+    dt = jnp.dtype(cfg.dtype)
+    D, I, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+    keys = jax.random.split(key, cfg.num_layers + 2)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        ks = jax.random.split(keys[i], 3)
+        layers.append({
+            "norm": jnp.ones((D,), dt),
+            "router": (jax.random.normal(ks[0], (D, E), jnp.float32)
+                       / np.sqrt(D)).astype(dt),
+            "w_in": (jax.random.normal(ks[1], (E, D, I), jnp.float32)
+                     / np.sqrt(D)).astype(dt),
+            "w_out": (jax.random.normal(ks[2], (E, I, D), jnp.float32)
+                      / np.sqrt(I)).astype(dt),
+        })
+    return {
+        "embed": (jax.random.normal(keys[-2], (cfg.vocab_size, D),
+                                    jnp.float32) * 0.02).astype(dt),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dt),
+        "head": (jax.random.normal(keys[-1], (D, cfg.vocab_size),
+                                   jnp.float32) / np.sqrt(D)).astype(dt),
+    }
+
+
+def param_shardings(cfg: MoEConfig, mesh: Mesh) -> dict:
+    ep = int(mesh.shape.get("ep", 1))
+
+    def sh(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    expert_ok = cfg.num_experts % ep == 0
+    layer = {
+        "norm": sh(None),
+        "router": sh(None, None),
+        # expert weights shard on the EXPERT axis — each ep group holds its
+        # experts only; dispatch rides the mesh as an all-to-all
+        "w_in": sh("ep", None, None) if expert_ok else sh(None, None, None),
+        "w_out": sh("ep", None, None) if expert_ok else sh(None, None, None),
+    }
+    return {
+        "embed": sh(None, None),
+        "layers": [dict(layer) for _ in range(cfg.num_layers)],
+        "final_norm": sh(None),
+        "head": sh(None, None),
+    }
+
+
+def route(logits, capacity: int):
+    """Top-1 routing with per-expert capacity.
+
+    logits [N, E] → (combine [N, E, C], dispatch bool [N, E, C]).
+    Invariants (tested): each token occupies ≤1 slot; each (expert, slot)
+    holds ≤1 token; tokens beyond an expert's capacity are dropped.
+    """
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                      # [N]
+    gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)      # [N, E]
+    # position of each token within its expert's queue (arrival order)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1            # [N, E], -1 ∉
+    kept = (pos >= 0) & (pos < capacity)
+    slot = jnp.where(kept, pos, 0)
+    dispatch = kept[..., None] & (
+        jax.nn.one_hot(slot, capacity, dtype=jnp.int32) > 0)  # [N, E, C]
+    combine = dispatch * gate[:, None, None]
+    return combine.astype(logits.dtype), dispatch
+
+
+def moe_ffn(layer, x, cfg: MoEConfig):
+    """x [B, T, D] → [B, T, D] through capacity-routed experts."""
+    B, T, D = x.shape
+    N = B * T
+    E = cfg.num_experts
+    capacity = max(1, int(cfg.capacity_factor * N / E))
+    flat = x.reshape(N, D)
+    logits = flat @ layer["router"]
+    combine, dispatch = route(logits, capacity)
+    # dispatch: [N, E, C] × [N, D] → expert buffers [E, C, D]
+    buf = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), flat)
+    h = jax.nn.silu(jnp.einsum("ecd,edi->eci", buf, layer["w_in"]))
+    out = jnp.einsum("eci,eid->ecd", h, layer["w_out"])
+    y = jnp.einsum("nec,ecd->nd", combine, out)
+    return y.reshape(B, T, D)
+
+
+def forward(params, tokens, cfg: MoEConfig, mesh: Mesh | None = None):
+    del mesh
+    x = params["embed"][tokens]
+    for layer in params["layers"]:
+        x = x + moe_ffn(layer, rms_norm(x, layer["norm"]), cfg)
+    x = rms_norm(x, params["final_norm"])
+    return x @ params["head"]
+
+
+def loss_fn(params, tokens, cfg: MoEConfig):
+    logits = forward(params, tokens[:, :-1], cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, tokens[:, 1:][..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
+def make_train_step(cfg: MoEConfig, mesh: Mesh | None = None,
+                    lr: float = 1e-3, momentum: float = 0.9):
+    del mesh  # placement comes from the param shardings
+
+    def init_opt(params):
+        return jax.tree.map(jnp.zeros_like, params)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        new_opt = jax.tree.map(lambda m, g: momentum * m + g, opt_state, grads)
+        new_params = jax.tree.map(lambda p, m: p - lr * m, params, new_opt)
+        return new_params, new_opt, loss
+
+    return init_opt, jax.jit(train_step)
