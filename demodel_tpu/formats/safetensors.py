@@ -158,7 +158,10 @@ def serialize(tensors: dict[str, np.ndarray],
     bodies: list[bytes] = []
     off = 0
     for name, arr in tensors.items():
-        arr = np.ascontiguousarray(arr)
+        arr = np.asarray(arr)
+        if arr.ndim and not arr.flags["C_CONTIGUOUS"]:
+            # NOT ascontiguousarray unconditionally: it promotes 0-d to (1,)
+            arr = np.ascontiguousarray(arr)
         raw = arr.tobytes()
         header[name] = {
             "dtype": _tag_for(arr.dtype),

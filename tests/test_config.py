@@ -1,0 +1,52 @@
+"""Env-config semantics — the reference's intended behavior, bugs fixed
+(SURVEY.md §5: the ``strings.Split("", ",")`` → ``[""]`` clobber must NOT
+be reproduced)."""
+
+import pytest
+
+from demodel_tpu.config import DEFAULT_MITM_HOSTS, ProxyConfig
+from demodel_tpu.utils.env import env_bool
+
+
+def test_defaults_apply_when_env_unset(monkeypatch):
+    for var in ("DEMODEL_PROXY_MITM_HOSTS", "DEMODEL_PROXY_MITM_EXTRA_HOSTS",
+                "DEMODEL_PROXY_MITM_ALL", "DEMODEL_PROXY_NO_MITM"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = ProxyConfig.from_env()
+    # the reference's latent bug clobbered this to [""] — defaults survive
+    assert cfg.mitm_hosts == DEFAULT_MITM_HOSTS == ["huggingface.co:443"]
+    assert cfg.port == 8080  # reference listens on :8080 (start.go:206)
+    assert not cfg.mitm_all and not cfg.no_mitm
+
+
+def test_hosts_replace_and_extend(monkeypatch):
+    monkeypatch.setenv("DEMODEL_PROXY_MITM_HOSTS", "a.example:443, b.example:443")
+    monkeypatch.setenv("DEMODEL_PROXY_MITM_EXTRA_HOSTS", "c.example:8443")
+    cfg = ProxyConfig.from_env()
+    assert cfg.mitm_hosts == ["a.example:443", "b.example:443",
+                              "c.example:8443"]
+    # set-but-empty clears (explicit intent), extras still extend
+    monkeypatch.setenv("DEMODEL_PROXY_MITM_HOSTS", "")
+    monkeypatch.setenv("DEMODEL_PROXY_MITM_EXTRA_HOSTS", "")
+    assert ProxyConfig.from_env().mitm_hosts == []
+
+
+def test_policy_precedence():
+    """no_mitm wins over mitm_all wins over the host list
+    (``start.go:183-196`` order, minus the bug)."""
+    cfg = ProxyConfig(mitm_hosts=["hub.example:443"])
+    assert cfg.should_mitm("hub.example:443")
+    assert not cfg.should_mitm("other.example:443")
+    assert ProxyConfig(mitm_all=True).should_mitm("anything:443")
+    assert not ProxyConfig(mitm_all=True, no_mitm=True).should_mitm("x:443")
+    assert not ProxyConfig(no_mitm=True,
+                           mitm_hosts=["hub.example:443"]).should_mitm(
+        "hub.example:443")
+
+
+@pytest.mark.parametrize("raw,want", [
+    ("", False), ("0", False), ("1", True), ("TRUE", True), ("true", True),
+])
+def test_env_bool(monkeypatch, raw, want):
+    monkeypatch.setenv("DEMODEL_TEST_FLAG", raw)
+    assert env_bool("DEMODEL_TEST_FLAG") is want

@@ -1,0 +1,149 @@
+"""safetensors + GGUF codecs: roundtrips, validation, upstream parity."""
+
+import numpy as np
+import pytest
+
+from demodel_tpu.formats import gguf
+from demodel_tpu.formats import safetensors as st
+
+
+def test_safetensors_roundtrip():
+    rng = np.random.default_rng(0)
+    tensors = {
+        "w": rng.standard_normal((16, 8)).astype(np.float32),
+        "b": rng.standard_normal((8,)).astype(np.float32),
+        "scalar": np.float32(3.5).reshape(()),
+        "ids": np.arange(10, dtype=np.int64),
+    }
+    blob = st.serialize(tensors, metadata={"format": "pt"})
+    idx = st.parse_header(blob)
+    assert set(idx.tensors) == set(tensors)
+    assert idx.metadata == {"format": "pt"}
+    for name, src in tensors.items():
+        spec = idx.tensors[name]
+        got = spec.to_numpy(blob[spec.start:spec.end])
+        np.testing.assert_array_equal(got, src)
+
+
+def test_safetensors_bf16():
+    import ml_dtypes
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 4)).astype(ml_dtypes.bfloat16)
+    blob = st.serialize({"x": x})
+    idx = st.parse_header(blob)
+    assert idx.tensors["x"].dtype == "BF16"
+    got = idx.tensors["x"].to_numpy(
+        blob[idx.tensors["x"].start:idx.tensors["x"].end])
+    np.testing.assert_array_equal(got, x)
+
+
+def test_safetensors_header_corruption():
+    blob = st.serialize({"x": np.zeros((2, 2), np.float32)})
+    with pytest.raises(ValueError):
+        st.parse_header(b"\xff" * 32)
+    with pytest.raises(ValueError):
+        st.parse_header(blob[:4])  # truncated length prefix
+    # absurd header length must not allocate/scan
+    bad = (2 ** 40).to_bytes(8, "little") + blob[8:]
+    with pytest.raises(ValueError, match="out of bounds"):
+        st.parse_header(bad)
+
+
+def test_safetensors_offset_validation():
+    import json
+    import struct
+
+    hdr = json.dumps({
+        "x": {"dtype": "F32", "shape": [4], "data_offsets": [0, 99]},
+    }).encode()
+    blob = struct.pack("<Q", len(hdr)) + hdr + b"\0" * 99
+    with pytest.raises(ValueError, match="span"):
+        st.parse_header(blob)
+    hdr = json.dumps({
+        "x": {"dtype": "F32", "shape": [4], "data_offsets": [0, 16]},
+    }).encode()
+    blob = struct.pack("<Q", len(hdr)) + hdr + b"\0" * 8  # data too short
+    with pytest.raises(ValueError, match="out of bounds"):
+        st.parse_header(blob)
+
+
+def test_safetensors_matches_upstream_wheel():
+    """Our serializer writes files the upstream ``safetensors`` wheel reads
+    bit-exactly (wire compatibility both ways)."""
+    pytest.importorskip("safetensors")
+    from safetensors.numpy import load, save
+
+    rng = np.random.default_rng(2)
+    tensors = {"a": rng.standard_normal((8, 3)).astype(np.float32),
+               "b": np.arange(6, dtype=np.int32)}
+    theirs = load(bytes(st.serialize(tensors)))
+    for name in tensors:
+        np.testing.assert_array_equal(theirs[name], tensors[name])
+    # and theirs parses under ours
+    blob2 = save(tensors)
+    idx = st.parse_header(blob2)
+    for name in tensors:
+        spec = idx.tensors[name]
+        np.testing.assert_array_equal(
+            spec.to_numpy(blob2[spec.start:spec.end]), tensors[name])
+
+
+def test_safetensors_reads_upstream_wheel():
+    pytest.importorskip("safetensors")
+    from safetensors.numpy import save
+
+    x = np.random.default_rng(3).standard_normal((5, 7)).astype(np.float16)
+    blob = save({"h": x})
+    idx = st.read_index_from(
+        lambda off, ln: blob[off:off + ln], total_size=len(blob))
+    spec = idx.tensors["h"]
+    np.testing.assert_array_equal(spec.to_numpy(blob[spec.start:spec.end]), x)
+
+
+# ---------------------------------------------------------------- gguf
+
+
+def test_gguf_roundtrip_f32_f16():
+    rng = np.random.default_rng(4)
+    t32 = rng.standard_normal((8, 32)).astype(np.float32)
+    t16 = rng.standard_normal((4, 64)).astype(np.float32)
+    blob = gguf.serialize({"a": t32, "b": t16},
+                          {"a": gguf.GGML_F32, "b": gguf.GGML_F16},
+                          metadata={"general.name": "fixture"})
+    idx = gguf.parse(blob)
+    assert idx.metadata["general.name"] == "fixture"
+    a = idx.tensors["a"]
+    got = gguf.decode_raw(a, blob[a.start:a.start + a.nbytes])
+    np.testing.assert_array_equal(got, t32)
+    b = idx.tensors["b"]
+    got16 = gguf.decode_raw(b, blob[b.start:b.start + b.nbytes])
+    np.testing.assert_array_equal(got16, t16.astype(np.float16))
+    # data section honors alignment
+    assert idx.data_start % idx.alignment == 0
+    assert a.start % idx.alignment == 0
+
+
+def test_gguf_rejects_garbage():
+    with pytest.raises(ValueError, match="magic"):
+        gguf.parse(b"NOPE" + b"\0" * 100)
+    blob = gguf.serialize({"x": np.zeros((2, 32), np.float32)})
+    with pytest.raises(ValueError):
+        gguf.parse(blob[:20])  # truncated header walk
+
+
+def _quant_rel_err(ggml_type: int) -> float:
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal(64 * gguf.QK).astype(np.float32)
+    raw = gguf.encode(x, ggml_type)
+    t = gguf.GGUFTensor("x", ggml_type, (x.size,), 0, len(raw))
+    y = gguf.REF_DEQUANT[ggml_type](*gguf.decode_raw(t, raw))
+    return float(np.abs(y - x).max() / np.abs(x).max())
+
+
+def test_gguf_q8_0_quantization_error_bounded():
+    assert _quant_rel_err(gguf.GGML_Q8_0) < 0.01
+
+
+def test_gguf_q4_0_quantization_error_bounded():
+    assert _quant_rel_err(gguf.GGML_Q4_0) < 0.10

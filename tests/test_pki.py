@@ -1,0 +1,76 @@
+"""CA lifecycle + leaf minting — reference ``init.go``/``start.go``
+semantics without the bugs (pwd-relative trust path, mint race)."""
+
+import ssl
+import threading
+
+from cryptography import x509
+from cryptography.x509.oid import ExtensionOID
+
+from demodel_tpu import pki
+
+
+def test_ca_create_and_reload(tmp_path):
+    ca1 = pki.read_or_new_ca(tmp_path)
+    cert_path, key_path = pki.ca_paths(tmp_path)
+    assert cert_path.exists() and key_path.exists()
+    # key is private (0600), cert is world-readable (0644) — init.go:135-143
+    assert (key_path.stat().st_mode & 0o777) == 0o600
+    assert (cert_path.stat().st_mode & 0o777) == 0o644
+    bc = ca1.cert.extensions.get_extension_for_oid(
+        ExtensionOID.BASIC_CONSTRAINTS).value
+    assert bc.ca and bc.path_length == 0  # CA:TRUE, MaxPathLenZero
+
+    ca2 = pki.read_or_new_ca(tmp_path)  # second call loads, not re-mints
+    assert ca2.cert_pem == ca1.cert_pem
+
+
+def test_ca_ecdsa(tmp_path):
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    ca = pki.read_or_new_ca(tmp_path, use_ecdsa=True)
+    assert isinstance(ca.key, ec.EllipticCurvePrivateKey)
+
+
+def test_leaf_mint_and_cache(tmp_path):
+    ca = pki.read_or_new_ca(tmp_path, use_ecdsa=True)
+    minter = pki.LeafMinter(ca, tmp_path, use_ecdsa=True)
+    cert_path, key_path = minter.fetch("example.test")
+    leaf = x509.load_pem_x509_certificate(open(cert_path, "rb").read())
+    san = leaf.extensions.get_extension_for_oid(
+        ExtensionOID.SUBJECT_ALTERNATIVE_NAME).value
+    assert san.get_values_for_type(x509.DNSName) == ["example.test"]
+    # cached: second fetch returns identical paths without re-minting
+    assert minter.fetch("example.test") == (cert_path, key_path)
+    # the chain file + key load as a working TLS server identity
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+
+
+def test_leaf_ip_san(tmp_path):
+    import ipaddress
+
+    ca = pki.read_or_new_ca(tmp_path, use_ecdsa=True)
+    minter = pki.LeafMinter(ca, tmp_path, use_ecdsa=True)
+    cert_path, _ = minter.fetch("127.0.0.1")
+    leaf = x509.load_pem_x509_certificate(open(cert_path, "rb").read())
+    san = leaf.extensions.get_extension_for_oid(
+        ExtensionOID.SUBJECT_ALTERNATIVE_NAME).value
+    assert san.get_values_for_type(x509.IPAddress) == [
+        ipaddress.ip_address("127.0.0.1")]
+
+
+def test_leaf_mint_concurrent(tmp_path):
+    """The reference mints the same host twice under a race
+    (``start.go:118-120`` TOCTOU); ours must yield one mint per host."""
+    ca = pki.read_or_new_ca(tmp_path, use_ecdsa=True)
+    minter = pki.LeafMinter(ca, tmp_path, use_ecdsa=True)
+    results = []
+
+    def fetch():
+        results.append(minter.fetch("racy.test"))
+
+    ts = [threading.Thread(target=fetch) for _ in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(set(results)) == 1

@@ -1,0 +1,497 @@
+"""End-to-end MITM proxy tests: real TLS both legs, real HTTP clients.
+
+Each test drives the native data plane the way the reference's runbook does
+(``CONTRIBUTING.md:26-51`` — curl/clients through ``HTTPS_PROXY``), against
+a loopback TLS upstream signed by a throwaway CA. The client trusts ONLY
+the proxy's CA — every assertion therefore proves the MITM leg worked.
+"""
+
+import gzip
+import threading
+import time
+
+import pytest
+import requests
+
+from demodel_tpu import pki
+from demodel_tpu.config import ProxyConfig
+from demodel_tpu.proxy import ProxyServer
+from demodel_tpu.store import Store
+
+from .servers import FakeUpstream
+
+from http.server import BaseHTTPRequestHandler
+
+
+_BODY = b"model-bytes-" * 4096  # 48KB
+_GZ = gzip.compress(b"json-ish " * 1000)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Origin with the behaviors the cache policy must honor."""
+
+    protocol_version = "HTTP/1.1"
+    hits: dict[str, int] = {}
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _count(self):
+        with self.lock:
+            path = self.path.split("?")[0]
+            self.hits[path] = self.hits.get(path, 0) + 1
+
+    def _send(self, status, body=b"", ctype="application/octet-stream",
+              extra=None):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if self.command != "HEAD" and body:
+            self.wfile.write(body)
+
+    def do_HEAD(self):
+        self.do_GET()
+
+    def do_GET(self):  # noqa: C901
+        self._count()
+        path = self.path.split("?")[0]
+        if path == "/blob":
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                a, _, b = rng[6:].partition("-")
+                start = int(a) if a else max(0, len(_BODY) - int(b))
+                end = int(b) if (a and b) else len(_BODY) - 1
+                part = _BODY[start:end + 1]
+                self._send(206, part, extra={
+                    "Content-Range":
+                        f"bytes {start}-{start + len(part) - 1}/{len(_BODY)}",
+                    "Accept-Ranges": "bytes"})
+                return
+            self._send(200, _BODY, extra={"Accept-Ranges": "bytes",
+                                          "ETag": '"blob-v1"'})
+        elif path == "/gz":
+            self._send(200, _GZ, ctype="application/json",
+                       extra={"Content-Encoding": "gzip"})
+        elif path == "/chunked":
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for part in (b"alpha-", b"beta-", b"gamma"):
+                self.wfile.write(f"{len(part):x}\r\n".encode() + part + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+        elif path == "/meta":
+            self._send(200, b"meta-body", extra={
+                "X-Linked-Etag": '"' + "ab" * 32 + '"',
+                "X-Linked-Size": "9", "X-Repo-Commit": "c0ffee"})
+        elif path == "/private":
+            auth = self.headers.get("Authorization")
+            if not auth:
+                self._send(401, b"need auth")
+            else:
+                self._send(200, b"secret-for-" + auth.encode(),
+                           extra={"Cache-Control": "private"})
+        elif path == "/nostore":
+            self._send(200, b"volatile", extra={"Cache-Control": "no-store"})
+        elif path == "/flaky":
+            self._send(500, b"boom")
+        elif path == "/redir":
+            self._send(302, b"", extra={"Location": "/blob"})
+        elif path == "/slowblob":
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(1 << 20))
+            self.end_headers()
+            for _ in range(16):
+                self.wfile.write(b"z" * (1 << 16))
+                time.sleep(0.05)
+        else:
+            self._send(200, f"echo:{path}".encode(), ctype="text/plain")
+
+    def do_POST(self):
+        self._count()
+        n = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(n)
+        self._send(200, b"posted")
+
+
+@pytest.fixture()
+def rig(tmp_path, monkeypatch):
+    """(session, upstream, proxy, authority) — client trusts only the
+    proxy CA; MITM list pins the upstream authority.
+
+    The env CA bundles must go: requests' merge_environment_settings lets
+    REQUESTS_CA_BUNDLE/CURL_CA_BUNDLE silently override ``Session.verify``
+    (the same quirk the Fetcher works around with per-request verify)."""
+    for var in ("REQUESTS_CA_BUNDLE", "CURL_CA_BUNDLE"):
+        monkeypatch.delenv(var, raising=False)
+    _Handler.hits = {}
+    with FakeUpstream(handler=_Handler, tls_dir=tmp_path / "hubca") as up:
+        cfg = ProxyConfig(
+            host="127.0.0.1", port=0, mitm_hosts=[up.authority],
+            cache_dir=tmp_path / "cache", data_dir=tmp_path / "data",
+            use_ecdsa=True,
+        )
+        with ProxyServer(cfg, upstream_ca=str(up.ca_path),
+                         verbose=False) as proxy:
+            s = requests.Session()
+            s.proxies = {"https": f"http://127.0.0.1:{proxy.port}",
+                         "http": f"http://127.0.0.1:{proxy.port}"}
+            s.verify = str(pki.ca_paths(cfg.data_dir)[0])
+            yield s, up, proxy, f"https://{up.authority}"
+
+
+def test_mitm_basic_and_cache_hit(rig):
+    s, up, proxy, base = rig
+    r1 = s.get(f"{base}/blob", timeout=30)
+    assert r1.status_code == 200 and r1.content == _BODY
+    assert r1.headers.get("X-Demodel-Cache") == "MISS"
+    r2 = s.get(f"{base}/blob", timeout=30)
+    assert r2.content == _BODY
+    assert r2.headers.get("X-Demodel-Cache") == "HIT"
+    assert _Handler.hits["/blob"] == 1  # second served locally
+    assert r2.headers.get("ETag") == '"blob-v1"'
+
+
+def test_cache_survives_upstream_death(rig):
+    s, up, proxy, base = rig
+    assert s.get(f"{base}/blob", timeout=30).status_code == 200
+    up.stop()
+    r = s.get(f"{base}/blob", timeout=30)
+    assert r.status_code == 200 and r.content == _BODY
+    assert r.headers.get("X-Demodel-Cache") == "HIT"
+
+
+def test_head_request(rig):
+    s, _, _, base = rig
+    assert s.get(f"{base}/blob", timeout=30).status_code == 200
+    r = s.head(f"{base}/blob", timeout=30)
+    assert r.status_code == 200 and r.content == b""
+    assert int(r.headers["Content-Length"]) == len(_BODY)
+    assert r.headers.get("X-Demodel-Cache") == "HIT"
+
+
+def test_plain_http_proxying(rig, tmp_path):
+    """Absolute-form plain-HTTP proxying (no CONNECT, no TLS)."""
+    s, _, proxy, _ = rig
+    with FakeUpstream(handler=_Handler) as plain:
+        r = s.get(f"http://{plain.authority}/echo-plain", timeout=30)
+        assert r.status_code == 200 and r.content == b"echo:/echo-plain"
+
+
+def test_tunnel_mode_not_intercepted(rig, tmp_path):
+    """Authorities off the MITM list are blind-tunneled: the client sees
+    the UPSTREAM's certificate, not the proxy's."""
+    s, up, proxy, base = rig
+    with FakeUpstream(handler=_Handler, tls_dir=tmp_path / "otherca") as other:
+        # client trusts only the proxy CA → the un-MITM'd leg must fail TLS
+        with pytest.raises(requests.exceptions.SSLError):
+            s.get(f"https://{other.authority}/echo", timeout=30)
+        # trusting the OTHER upstream's CA makes the tunnel work
+        r = requests.get(
+            f"https://{other.authority}/echo",
+            proxies=s.proxies, verify=str(other.ca_path), timeout=30)
+        assert r.content == b"echo:/echo"
+
+
+def test_mitm_all_flag(tmp_path):
+    _Handler.hits = {}
+    with FakeUpstream(handler=_Handler, tls_dir=tmp_path / "hubca") as up:
+        cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_all=True,
+                          mitm_hosts=[], cache_dir=tmp_path / "cache",
+                          data_dir=tmp_path / "data", use_ecdsa=True)
+        with ProxyServer(cfg, upstream_ca=str(up.ca_path),
+                         verbose=False) as proxy:
+            r = requests.get(
+                f"https://{up.authority}/blob",
+                proxies={"https": f"http://127.0.0.1:{proxy.port}"},
+                verify=str(pki.ca_paths(cfg.data_dir)[0]), timeout=30)
+            assert r.content == _BODY  # intercepted despite empty host list
+
+
+def test_no_mitm_flag_overrides(tmp_path):
+    with FakeUpstream(handler=_Handler, tls_dir=tmp_path / "hubca") as up:
+        cfg = ProxyConfig(host="127.0.0.1", port=0, no_mitm=True, mitm_all=True,
+                          mitm_hosts=[up.authority],
+                          cache_dir=tmp_path / "cache",
+                          data_dir=tmp_path / "data", use_ecdsa=True)
+        with ProxyServer(cfg, upstream_ca=str(up.ca_path),
+                         verbose=False) as proxy:
+            # no_mitm beats everything → tunneled → upstream cert visible
+            r = requests.get(
+                f"https://{up.authority}/echo",
+                proxies={"https": f"http://127.0.0.1:{proxy.port}"},
+                verify=str(up.ca_path), timeout=30)
+            assert r.content == b"echo:/echo"
+
+
+def test_concurrent_clients(rig):
+    s, _, proxy, base = rig
+    results, errs = [], []
+
+    def hit(i):
+        try:
+            ses = requests.Session()
+            ses.proxies = s.proxies
+            ses.verify = s.verify
+            r = ses.get(f"{base}/blob", timeout=30)
+            results.append(r.content == _BODY)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=hit, args=(i,)) for i in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs and all(results) and len(results) == 8
+
+
+def test_content_encoding_preserved_in_cache(rig, tmp_path):
+    """Bodies cache exactly as transferred — gzip stays gzip on replay
+    (the legacy cache's defining property, CONTRIBUTING.md:76,116)."""
+    s, up, proxy, base = rig
+    r1 = s.get(f"{base}/gz", timeout=30)
+    r2 = s.get(f"{base}/gz", timeout=30)
+    assert r2.headers.get("X-Demodel-Cache") == "HIT"
+    assert r2.headers.get("Content-Encoding") == "gzip"
+    assert r1.content == r2.content == gzip.decompress(_GZ)  # requests inflates
+    store = Store(tmp_path / "cache" / "proxy")
+    try:
+        keys = store.list()
+        raws = [store.get(k) for k in keys]
+        assert any(raw == _GZ for raw in raws)  # on-wire bytes, not inflated
+    finally:
+        store.close()
+
+
+def test_chunked_upstream_response(rig):
+    s, _, _, base = rig
+    r1 = s.get(f"{base}/chunked", timeout=30)
+    assert r1.content == b"alpha-beta-gamma"
+    r2 = s.get(f"{base}/chunked", timeout=30)
+    assert r2.content == b"alpha-beta-gamma"
+    assert r2.headers.get("X-Demodel-Cache") == "HIT"
+    assert _Handler.hits["/chunked"] == 1
+
+
+def test_hf_metadata_headers_survive_cache(rig):
+    """X-Linked-Etag / X-Linked-Size / X-Repo-Commit replay on hits —
+    huggingface_hub's metadata HEADs must work offline."""
+    s, _, _, base = rig
+    s.get(f"{base}/meta", timeout=30)
+    r = s.head(f"{base}/meta", timeout=30)
+    assert r.headers.get("X-Demodel-Cache") == "HIT"
+    assert r.headers.get("X-Linked-Etag") == '"' + "ab" * 32 + '"'
+    assert r.headers.get("X-Linked-Size") == "9"
+    assert r.headers.get("X-Repo-Commit") == "c0ffee"
+
+
+def test_error_status_not_cached(rig):
+    s, _, _, base = rig
+    assert s.get(f"{base}/flaky", timeout=30).status_code == 500
+    assert s.get(f"{base}/flaky", timeout=30).status_code == 500
+    assert _Handler.hits["/flaky"] == 2  # both went upstream
+
+
+def test_post_not_cached(rig):
+    s, _, _, base = rig
+    assert s.post(f"{base}/blob", data=b"x" * 100, timeout=30).content == b"posted"
+    assert s.post(f"{base}/blob", data=b"x" * 100, timeout=30).content == b"posted"
+    assert _Handler.hits["/blob"] == 2
+
+
+def test_no_store_not_cached(rig):
+    s, _, _, base = rig
+    s.get(f"{base}/nostore", timeout=30)
+    r = s.get(f"{base}/nostore", timeout=30)
+    assert r.headers.get("X-Demodel-Cache") == "MISS"
+    assert _Handler.hits["/nostore"] == 2
+
+
+def test_private_not_cached_for_anon(rig):
+    """Cache-Control: private + credentialed fetch → auth-scoped entry; an
+    anonymous client must go upstream (and get the 401), never the cache."""
+    s, _, _, base = rig
+    r = s.get(f"{base}/private", headers={"Authorization": "Bearer tok-a"},
+              timeout=30)
+    assert r.content == b"secret-for-Bearer tok-a"
+    # same credential → auth-scoped HIT
+    r2 = s.get(f"{base}/private", headers={"Authorization": "Bearer tok-a"},
+               timeout=30)
+    assert r2.headers.get("X-Demodel-Cache") == "HIT"
+    # anonymous → upstream 401, nothing leaked
+    r3 = s.get(f"{base}/private", timeout=30)
+    assert r3.status_code == 401
+
+
+def test_auth_scoped_cache(rig):
+    """Distinct credentials get distinct cache entries — tok-b must not be
+    served tok-a's bytes."""
+    s, _, _, base = rig
+    ra = s.get(f"{base}/private", headers={"Authorization": "Bearer tok-a"},
+               timeout=30)
+    rb = s.get(f"{base}/private", headers={"Authorization": "Bearer tok-b"},
+               timeout=30)
+    assert ra.content != rb.content
+    assert _Handler.hits["/private"] == 2
+    rb2 = s.get(f"{base}/private", headers={"Authorization": "Bearer tok-b"},
+                timeout=30)
+    assert rb2.content == rb.content
+    assert rb2.headers.get("X-Demodel-Cache") == "HIT"
+
+
+def test_redirect_passthrough(rig):
+    s, _, _, base = rig
+    r = s.get(f"{base}/redir", timeout=30, allow_redirects=False)
+    assert r.status_code == 302
+    assert r.headers["Location"].endswith("/blob")
+    r2 = s.get(f"{base}/redir", timeout=30)  # follow through the proxy
+    assert r2.content == _BODY
+
+
+def test_range_served_from_cache(rig):
+    s, _, _, base = rig
+    s.get(f"{base}/blob", timeout=30)  # warm
+    r = s.get(f"{base}/blob", headers={"Range": "bytes=100-199"}, timeout=30)
+    assert r.status_code == 206
+    assert r.content == _BODY[100:200]
+    assert r.headers["Content-Range"] == f"bytes 100-199/{len(_BODY)}"
+    assert _Handler.hits["/blob"] == 1
+
+
+def test_ranged_miss_fills_cache(rig):
+    """A cold Range request triggers a full-object fill: the client gets
+    its 206 window while the whole blob lands in the cache."""
+    s, _, _, base = rig
+    r = s.get(f"{base}/blob", headers={"Range": "bytes=1000-1999"}, timeout=30)
+    assert r.status_code == 206 and r.content == _BODY[1000:2000]
+    assert r.headers.get("X-Demodel-Cache") in ("FILL", "FILL-ATTACH")
+    time.sleep(0.3)  # fill commit is asynchronous wrt the client's window
+    r2 = s.get(f"{base}/blob", timeout=30)
+    assert r2.content == _BODY
+    assert r2.headers.get("X-Demodel-Cache") == "HIT"
+    assert _Handler.hits["/blob"] == 1
+
+
+def test_ranged_miss_suffix_and_open_end(rig):
+    s, _, _, base = rig
+    r = s.get(f"{base}/blob", headers={"Range": "bytes=-100"}, timeout=30)
+    assert r.status_code == 206 and r.content == _BODY[-100:]
+    r = s.get(f"{base}/blob", headers={"Range": f"bytes={len(_BODY) - 50}-"},
+              timeout=30)
+    assert r.status_code == 206 and r.content == _BODY[-50:]
+
+
+def test_concurrent_cold_ranged_gets_one_object(rig):
+    """Two cold ranged clients attach to ONE upstream fill (fill-attach) —
+    the origin sees a single fetch."""
+    s, _, _, base = rig
+    outs, errs = [], []
+
+    def hit(lo, hi):
+        try:
+            ses = requests.Session()
+            ses.proxies = s.proxies
+            ses.verify = s.verify
+            r = ses.get(f"{base}/blob", headers={"Range": f"bytes={lo}-{hi}"},
+                        timeout=30)
+            outs.append((r.status_code, r.content == _BODY[lo:hi + 1]))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=hit, args=a)
+          for a in ((0, 9999), (20000, 29999), (40000, 48000))]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs
+    assert all(code == 206 and ok for code, ok in outs)
+    assert _Handler.hits["/blob"] == 1
+
+
+def test_cors_headers_on_miss_and_hit(rig):
+    """transformers.js (browser) needs Access-Control-* on cached replies
+    too, or models only load while the origin is reachable."""
+    s, _, _, base = rig
+    h = {"Origin": "https://app.example"}
+    r1 = s.get(f"{base}/blob", headers=h, timeout=30)
+    r2 = s.get(f"{base}/blob", headers=h, timeout=30)
+    for r in (r1, r2):
+        assert r.headers.get("Access-Control-Allow-Origin") == "https://app.example"
+    assert "X-Demodel-Cache" in r2.headers.get(
+        "Access-Control-Expose-Headers", "")
+    assert r2.headers.get("X-Demodel-Cache") == "HIT"
+
+
+def test_cors_absent_without_origin(rig):
+    s, _, _, base = rig
+    r = s.get(f"{base}/blob", timeout=30)
+    assert "Access-Control-Allow-Origin" not in r.headers
+
+
+def test_cors_preflight_through_mitm(rig):
+    """OPTIONS preflight answered locally (works with the origin down)."""
+    s, up, _, base = rig
+    up.stop()
+    r = s.options(f"{base}/blob", headers={
+        "Origin": "https://app.example",
+        "Access-Control-Request-Method": "GET",
+        "Access-Control-Request-Headers": "range,authorization",
+    }, timeout=30)
+    assert r.status_code == 204
+    assert r.headers["Access-Control-Allow-Origin"] == "https://app.example"
+    assert "GET" in r.headers["Access-Control-Allow-Methods"]
+    assert r.headers["Access-Control-Allow-Headers"] == "range,authorization"
+
+
+def test_request_body_cap(tmp_path):
+    _Handler.hits = {}
+    with FakeUpstream(handler=_Handler, tls_dir=tmp_path / "hubca") as up:
+        cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[up.authority],
+                          cache_dir=tmp_path / "cache",
+                          data_dir=tmp_path / "data", use_ecdsa=True)
+        with ProxyServer(cfg, upstream_ca=str(up.ca_path), verbose=False,
+                         max_body_mb=1) as proxy:
+            r = requests.post(
+                f"https://{up.authority}/blob", data=b"z" * (2 << 20),
+                proxies={"https": f"http://127.0.0.1:{proxy.port}"},
+                verify=str(pki.ca_paths(cfg.data_dir)[0]), timeout=30)
+            assert r.status_code == 413
+
+
+def test_metrics_endpoint_direct(rig):
+    s, _, proxy, base = rig
+    s.get(f"{base}/blob", timeout=30)
+    m = proxy.metrics()
+    assert m["connects"] >= 1 and m["mitm"] >= 1 and m["requests"] >= 1
+    # origin-form /healthz on the proxy port answers without a proxy client
+    r = requests.get(f"http://127.0.0.1:{proxy.port}/healthz", timeout=10)
+    assert r.status_code == 200 and "requests" in r.json()
+
+
+def test_stop_during_active_transfer(rig):
+    """stop() while a client is mid-download: the session is force-closed
+    and stop() returns promptly — no hang, no crash (the r1 shutdown-race
+    fix)."""
+    s, _, proxy, base = rig
+    errs = []
+
+    def slow_pull():
+        try:
+            ses = requests.Session()
+            ses.proxies = s.proxies
+            ses.verify = s.verify
+            ses.get(f"{base}/slowblob", timeout=30)
+        except Exception as e:  # noqa: BLE001 — a failed pull is expected
+            errs.append(type(e).__name__)
+
+    t = threading.Thread(target=slow_pull)
+    t.start()
+    time.sleep(0.3)  # client is mid-body
+    t0 = time.time()
+    proxy.stop()
+    assert time.time() - t0 < 10, "stop() hung on a live transfer"
+    t.join(timeout=10)
+    assert not t.is_alive()

@@ -1,0 +1,257 @@
+"""Registry adapters: HF Hub + Ollama/registry-v2 pull clients."""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+import requests
+
+from demodel_tpu import delivery
+from demodel_tpu.config import ProxyConfig
+from demodel_tpu.parallel.peer import PeerSet
+from demodel_tpu.proxy import ProxyServer
+from demodel_tpu.registry.hf import HFRegistry
+from demodel_tpu.registry.ollama import OllamaRegistry, normalize_name
+from demodel_tpu.store import Store, key_for_uri
+
+from .fake_registries import (
+    build_hf_repo,
+    build_ollama_model,
+    make_hf_handler,
+    make_ollama_handler,
+)
+from .servers import FakeUpstream
+
+
+@pytest.fixture()
+def hf_rig(tmp_path):
+    repo = build_hf_repo(n_shards=2)
+    handler = make_hf_handler({"org/m": repo})
+    with FakeUpstream(handler=handler) as up:
+        store = Store(tmp_path / "store")
+        reg = HFRegistry(store, endpoint=f"http://{up.authority}")
+        yield reg, store, handler, repo, up
+        store.close()
+
+
+def test_hf_repo_info_and_list(hf_rig):
+    reg, _store, _handler, repo, _up = hf_rig
+    info = reg.repo_info("org/m")
+    assert info["id"] == "org/m" and "sha" in info
+    assert set(reg.list_files("org/m")) == set(repo)
+
+
+def test_hf_missing_repo_raises(hf_rig):
+    reg, *_ = hf_rig
+    with pytest.raises(requests.HTTPError):
+        reg.pull("org/ghost")
+
+
+def test_hf_pull_single_shard(tmp_path):
+    repo = build_hf_repo(n_shards=1)
+    handler = make_hf_handler({"org/s": repo})
+    with FakeUpstream(handler=handler) as up:
+        store = Store(tmp_path / "s")
+        try:
+            reg = HFRegistry(store, endpoint=f"http://{up.authority}")
+            report = reg.pull("org/s")
+            names = {f.name for f in report.files}
+            assert "model.safetensors" in names
+            art = next(f for f in report.files
+                       if f.name == "model.safetensors")
+            assert store.get(art.key) == repo["model.safetensors"]
+            assert art.sha256 == hashlib.sha256(
+                repo["model.safetensors"]).hexdigest()
+        finally:
+            store.close()
+
+
+def test_hf_pull_multi_shard_and_cache(hf_rig):
+    reg, store, handler, repo, _up = hf_rig
+    r1 = reg.pull("org/m")
+    assert r1.total_bytes == sum(len(v) for v in repo.values())
+    cdn1 = handler.request_counts.get("cdn", 0)
+    r2 = reg.pull("org/m")  # everything from cache
+    assert all(f.from_cache for f in r2.files)
+    assert handler.request_counts.get("cdn", 0) == cdn1
+
+
+def test_hf_resume_from_partial(hf_rig):
+    reg, store, handler, repo, up = hf_rig
+    fname = "model-00001-of-00002.safetensors"
+    body = repo[fname]
+    commit = "c0ffee" * 6 + "c0ff"
+    url = f"http://{up.authority}/org/m/resolve/{commit}/{fname}"
+    # LFS files are stored under the canonical resolve URI
+    key = key_for_uri(url)
+    w = store.begin(key)
+    w.append(body[:1000])
+    w.abort(keep_partial=True)
+
+    art = reg.fetch_file("org/m", commit, fname)
+    assert art.resumed_from in (0, 1000)  # CDN redirect may restart
+    assert store.get(art.key) == body
+
+
+def test_hf_materialize_snapshot(hf_rig, tmp_path):
+    reg, store, _h, repo, _up = hf_rig
+    report = reg.pull("org/m")
+    out = delivery.materialize(report, store, tmp_path / "snap")
+    got = {p.name: p.read_bytes() for p in out}
+    for name, body in repo.items():
+        assert got[name.replace("/", "_")] == body
+
+
+def test_hf_pull_through_mitm_proxy(tmp_path):
+    """First-party pull with HTTPS_PROXY-style routing through the MITM
+    node: bytes cross the proxy, the second pull is a proxy cache hit."""
+    repo = build_hf_repo(n_shards=1)
+    handler = make_hf_handler({"org/p": repo})
+    with FakeUpstream(handler=handler, tls_dir=tmp_path / "hubca") as up:
+        cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[up.authority],
+                          cache_dir=tmp_path / "cache",
+                          data_dir=tmp_path / "data", use_ecdsa=True)
+        with ProxyServer(cfg, upstream_ca=str(up.ca_path),
+                         verbose=False) as proxy:
+            from demodel_tpu import pki
+
+            ca = str(pki.ca_paths(cfg.data_dir)[0])
+            store = Store(tmp_path / "client-store")
+            try:
+                reg = HFRegistry(
+                    store, endpoint=f"https://{up.authority}", ca=ca,
+                    proxies={"https": f"http://127.0.0.1:{proxy.port}",
+                             "http": f"http://127.0.0.1:{proxy.port}"})
+                report = reg.pull("org/p")
+                assert report.total_bytes > 0
+                assert proxy.metrics()["mitm"] >= 1
+                hits_before = proxy.metrics()["cache_hits"]
+                store2 = Store(tmp_path / "client2-store")
+                try:
+                    reg2 = HFRegistry(
+                        store2, endpoint=f"https://{up.authority}", ca=ca,
+                        proxies=dict(reg.fetcher._proxies))
+                    reg2.pull("org/p")
+                finally:
+                    store2.close()
+                assert proxy.metrics()["cache_hits"] > hits_before
+            finally:
+                store.close()
+
+
+# ------------------------------------------------------------------ ollama
+
+
+def test_ollama_name_normalization():
+    assert normalize_name("llama3") == ("library/llama3", "latest")
+    assert normalize_name("llama3:8b") == ("library/llama3", "8b")
+    assert normalize_name("user/model") == ("user/model", "latest")
+    assert normalize_name("user/model:tag") == ("user/model", "tag")
+
+
+def test_ollama_pull_and_verify(tmp_path):
+    manifest, blobs = build_ollama_model()
+    handler = make_ollama_handler({"library/test:latest": manifest}, blobs)
+    with FakeUpstream(handler=handler) as up:
+        store = Store(tmp_path / "o")
+        try:
+            reg = OllamaRegistry(store, endpoint=f"http://{up.authority}")
+            report = reg.pull("test")
+            assert report.source == "ollama"
+            # manifest + config + 3 layers
+            assert len(report.files) == 5
+            for digest, body in blobs.items():
+                art = next(f for f in report.files if f.name == digest)
+                assert store.get(art.key) == body
+                assert art.sha256 == digest.split(":")[1]
+            model_art = next(
+                f for f in report.files
+                if f.media_type == "application/vnd.ollama.image.model")
+            assert model_art.size == len(
+                blobs[model_art.name])
+        finally:
+            store.close()
+
+
+def test_ollama_digest_mismatch_rejected(tmp_path):
+    manifest, blobs = build_ollama_model()
+    # corrupt one layer body under its advertised digest
+    bad_digest = manifest["layers"][0]["digest"]
+    blobs = dict(blobs)
+    blobs[bad_digest] = b"corrupted-bytes" * 100
+    manifest["layers"][0]["size"] = len(blobs[bad_digest])
+    handler = make_ollama_handler({"library/bad:latest": manifest}, blobs)
+    with FakeUpstream(handler=handler) as up:
+        store = Store(tmp_path / "ob")
+        try:
+            reg = OllamaRegistry(store, endpoint=f"http://{up.authority}")
+            with pytest.raises(IOError, match="digest mismatch"):
+                reg.pull("bad")
+            # nothing corrupt was committed
+            assert not store.has(
+                key_for_uri(reg.blob_url("library/bad", bad_digest)))
+        finally:
+            store.close()
+
+
+# ---------------------------------------------------------------- dedup
+
+
+def test_peer_dedup_by_digest(tmp_path):
+    """A peer holding the same CONTENT under a different key serves it by
+    content address — zero upstream bytes."""
+    repo = build_hf_repo(n_shards=1)
+    body = repo["model.safetensors"]
+    digest = hashlib.sha256(body).hexdigest()
+    cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                      cache_dir=tmp_path / "peer-cache",
+                      data_dir=tmp_path / "peer-data", use_ecdsa=True)
+    peer_store = Store(cfg.cache_dir / "proxy")
+    peer_store.put("totallydifferent1", body, {"sha256": digest,
+                                               "size": len(body)})
+    peer_store.close()
+    handler = make_hf_handler({"org/d": repo})
+    with ProxyServer(cfg, verbose=False) as peer, \
+            FakeUpstream(handler=handler) as up:
+        store = Store(tmp_path / "cold")
+        try:
+            reg = HFRegistry(store, endpoint=f"http://{up.authority}",
+                             peers=PeerSet([peer.url]))
+            report = reg.pull("org/d")
+            art = next(f for f in report.files
+                       if f.name == "model.safetensors")
+            assert art.from_peer
+            assert store.get(art.key) == body
+            assert handler.request_counts.get("cdn", 0) == 0
+        finally:
+            store.close()
+
+
+def test_pull_dedups_against_mitm_cached_bytes(tmp_path):
+    """Bytes the MITM proxy cached under the CDN URL are reused by a
+    first-party pull of the canonical URL via the digest hardlink — the
+    blob is stored once, served twice."""
+    repo = build_hf_repo(n_shards=1)
+    body = repo["model.safetensors"]
+    digest = hashlib.sha256(body).hexdigest()
+    handler = make_hf_handler({"org/x": repo})
+    with FakeUpstream(handler=handler) as up:
+        cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                          cache_dir=tmp_path / "cache",
+                          data_dir=tmp_path / "data", use_ecdsa=True)
+        store = Store(cfg.cache_dir / "proxy")
+        try:
+            # simulate the MITM tee: the CDN URL's bytes already cached
+            cdn_url = f"http://{up.authority}/cdn/org/x/{digest}"
+            store.put(key_for_uri(cdn_url), body, {"sha256": digest,
+                                                   "size": len(body)})
+            reg = HFRegistry(store, endpoint=f"http://{up.authority}")
+            report = reg.pull("org/x")
+            art = next(f for f in report.files
+                       if f.name == "model.safetensors")
+            # dedup: no CDN byte moved, the canonical key holds the bytes
+            assert handler.request_counts.get("cdn", 0) == 0
+            assert store.get(art.key) == body
+        finally:
+            store.close()

@@ -1,0 +1,209 @@
+"""HBM sink: store bytes → sharded device arrays (range reads only)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from demodel_tpu import delivery
+from demodel_tpu.config import ProxyConfig
+from demodel_tpu.formats import gguf
+from demodel_tpu.formats import safetensors as st
+from demodel_tpu.sink.hbm import deliver_gguf, deliver_safetensors
+from demodel_tpu.sink.plan import ShardingPlan
+from demodel_tpu.store import Store
+
+from .fake_registries import build_hf_repo, make_hf_handler
+from .servers import FakeUpstream
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = Store(tmp_path / "store")
+    yield s
+    s.close()
+
+
+def test_plan_rules(mesh8):
+    plan = ShardingPlan(mesh8)
+    # big, tp-divisible matrix → sharded on axis 0
+    assert plan.sharding_for("w", (128, 64), 4).spec == P("tp", None)
+    # not divisible by tp=8 → replicated
+    assert plan.sharding_for("w", (100, 64), 4).spec == P()
+    # small tensor under the byte threshold → replicated
+    assert plan.sharding_for("b", (64,), 4).spec == P()
+    # scalar → replicated
+    assert plan.sharding_for("s", (), 4).spec == P()
+    # 3-D divisible → sharded on leading axis
+    assert plan.sharding_for("e", (16, 8, 32), 4).spec == P("tp", None, None)
+
+
+def test_safetensors_placement_values_and_shardings(store, mesh8):
+    rng = np.random.default_rng(0)
+    tensors = {
+        "w": rng.standard_normal((128, 64)).astype(np.float32),
+        "b": rng.standard_normal((64,)).astype(np.float32),
+    }
+    blob = st.serialize(tensors)
+    store.put("sinkblob00000001", blob, {})
+    placed = deliver_safetensors(store, "sinkblob00000001", mesh=mesh8)
+    assert set(placed.arrays) == {"w", "b"}
+    assert placed.arrays["w"].sharding.spec == P("tp", None)
+    assert placed.arrays["b"].sharding.spec == P()
+    np.testing.assert_array_equal(np.asarray(placed.arrays["w"]), tensors["w"])
+    np.testing.assert_array_equal(np.asarray(placed.arrays["b"]), tensors["b"])
+
+
+def test_safetensors_placement_is_range_read_only(store, mesh8, monkeypatch):
+    """Delivery must never read the whole blob — per-shard ranges only."""
+    rng = np.random.default_rng(1)
+    tensors = {"w": rng.standard_normal((64, 32)).astype(np.float32)}
+    blob = st.serialize(tensors)
+    store.put("rangeonly0000001", blob, {})
+
+    max_read = 0
+    orig_pread = Store.pread
+    orig_into = Store.pread_into
+
+    def spy_pread(self, key, length, offset):
+        nonlocal max_read
+        if length > 1024:  # ignore header reads
+            max_read = max(max_read, length)
+        return orig_pread(self, key, length, offset)
+
+    def spy_into(self, key, out, offset=0):
+        nonlocal max_read
+        view = memoryview(out)
+        if view.nbytes > 1024:
+            max_read = max(max_read, view.nbytes)
+        return orig_into(self, key, out, offset)
+
+    monkeypatch.setattr(Store, "pread", spy_pread)
+    monkeypatch.setattr(Store, "pread_into", spy_into)
+    placed = deliver_safetensors(store, "rangeonly0000001", mesh=mesh8)
+    np.testing.assert_array_equal(np.asarray(placed.arrays["w"]), tensors["w"])
+    shard_bytes = tensors["w"].nbytes // 8
+    assert max_read <= shard_bytes, \
+        f"read {max_read} bytes at once; shard is only {shard_bytes}"
+
+
+def test_bf16_safetensors_delivery(store, mesh8):
+    import ml_dtypes
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((64, 32)).astype(ml_dtypes.bfloat16)
+    store.put("bf16blob00000001", st.serialize({"x": x}), {})
+    placed = deliver_safetensors(store, "bf16blob00000001", mesh=mesh8)
+    arr = placed.arrays["x"]
+    assert arr.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_scalar_tensor_delivery(store, mesh8):
+    blob = st.serialize({"step": np.float32(17.0).reshape(()),
+                         "w": np.ones((8, 8), np.float32)})
+    store.put("scalarblob000001", blob, {})
+    placed = deliver_safetensors(store, "scalarblob000001", mesh=mesh8)
+    assert placed.arrays["step"].shape == ()
+    assert float(placed.arrays["step"]) == 17.0
+
+
+# -------------------------------------------------------------------- gguf
+
+
+def _gguf_store(store, key, tensors, types):
+    blob = gguf.serialize(tensors, types)
+    store.put(key, blob, {})
+    return blob
+
+
+def test_gguf_placement_quantized(store, mesh8):
+    """Q8_0 weights dequantize on-device into the planned sharding."""
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((64, 256)).astype(np.float32)
+    blob = _gguf_store(store, "ggufq800000001aa", {"w": w},
+                       {"w": gguf.GGML_Q8_0})
+    placed = deliver_gguf(store, "ggufq800000001aa", mesh=mesh8,
+                          out_dtype=jnp.float32)
+    idx = gguf.parse(blob)
+    t = idx.tensors["w"]
+    ref = gguf.REF_DEQUANT[gguf.GGML_Q8_0](
+        *gguf.decode_raw(t, blob[t.start:t.start + t.nbytes])).reshape(64, 256)
+    np.testing.assert_allclose(np.asarray(placed.arrays["w"]), ref, atol=1e-5)
+    assert placed.arrays["w"].sharding.spec == P("tp", None)
+
+
+def test_gguf_q4_placement(store, mesh8):
+    rng = np.random.default_rng(4)
+    w = rng.standard_normal((32, 64)).astype(np.float32)
+    blob = _gguf_store(store, "ggufq400000001aa", {"w": w},
+                       {"w": gguf.GGML_Q4_0})
+    placed = deliver_gguf(store, "ggufq400000001aa", mesh=mesh8,
+                          out_dtype=jnp.float32)
+    idx = gguf.parse(blob)
+    t = idx.tensors["w"]
+    ref = gguf.REF_DEQUANT[gguf.GGML_Q4_0](
+        *gguf.decode_raw(t, blob[t.start:t.start + t.nbytes])).reshape(32, 64)
+    np.testing.assert_allclose(np.asarray(placed.arrays["w"]), ref, atol=1e-5)
+
+
+def test_gguf_k_quant_placement_sharded(store, mesh8):
+    """K-quant rows aligned to 256-elem super-blocks shard per-device —
+    each device dequantizes only its own rows."""
+    rng = np.random.default_rng(5)
+    w = rng.standard_normal((64, 256)).astype(np.float32)  # 256 % 256 == 0
+    blob = _gguf_store(store, "ggufq4k0000001aa", {"w": w},
+                       {"w": gguf.GGML_Q4_K})
+    placed = deliver_gguf(store, "ggufq4k0000001aa", mesh=mesh8,
+                          out_dtype=jnp.float32)
+    assert placed.arrays["w"].sharding.spec == P("tp", None)
+    idx = gguf.parse(blob)
+    t = idx.tensors["w"]
+    ref = gguf.REF_DEQUANT[gguf.GGML_Q4_K](
+        *gguf.decode_raw(t, blob[t.start:t.start + t.nbytes])).reshape(64, 256)
+    np.testing.assert_allclose(np.asarray(placed.arrays["w"]), ref, atol=1e-4)
+
+
+def test_gguf_q5_q2_placement_sharded(store, mesh8):
+    rng = np.random.default_rng(6)
+    w5 = rng.standard_normal((16, 256)).astype(np.float32)
+    w2 = rng.standard_normal((16, 256)).astype(np.float32)
+    blob = _gguf_store(store, "ggufq5q20000001a",
+                       {"w5": w5, "w2": w2},
+                       {"w5": gguf.GGML_Q5_K, "w2": gguf.GGML_Q2_K})
+    placed = deliver_gguf(store, "ggufq5q20000001a", mesh=mesh8,
+                          out_dtype=jnp.float32)
+    idx = gguf.parse(blob)
+    for name, t_id in (("w5", gguf.GGML_Q5_K), ("w2", gguf.GGML_Q2_K)):
+        t = idx.tensors[name]
+        ref = gguf.REF_DEQUANT[t_id](
+            *gguf.decode_raw(t, blob[t.start:t.start + t.nbytes])
+        ).reshape(16, 256)
+        np.testing.assert_allclose(np.asarray(placed.arrays[name]), ref,
+                                   atol=1e-4)
+        assert placed.arrays[name].sharding.spec == P("tp", None)
+
+
+def test_pull_with_tpu_sink_end_to_end(tmp_path, mesh8):
+    """`pull --sink=tpu`: registry walk → store → sharded arrays, values
+    equal to the source checkpoint (the SURVEY §7 minimum e2e slice)."""
+    repo = build_hf_repo(n_shards=2, rows=128)
+    handler = make_hf_handler({"org/sink": repo})
+    with FakeUpstream(handler=handler) as up:
+        cfg = ProxyConfig(cache_dir=tmp_path / "cache",
+                          data_dir=tmp_path / "data")
+        report, placed = delivery.pull_to_hbm(
+            "org/sink", cfg, endpoint=f"http://{up.authority}", mesh=mesh8)
+        assert placed is not None and len(placed.arrays) == 4
+        assert report["tpu_sink"]["tensors"] == 4
+        blob = repo["model-00001-of-00002.safetensors"]
+        spec = st.parse_header(blob).tensors["layer.0.w"]
+        np.testing.assert_array_equal(
+            np.asarray(placed.arrays["layer.0.w"]),
+            spec.to_numpy(blob[spec.start:spec.end]))
+        assert placed.arrays["layer.0.w"].sharding.spec == P("tp", None)
+        json.dumps(report)

@@ -1,0 +1,320 @@
+"""C++ content-addressed chunk store, driven through the ctypes binding.
+
+Covers the legacy-Rust cache data model (body + meta sidecar, reference
+CONTRIBUTING.md:53-154) plus the rebuild's additions: resumable writes,
+positional parallel range writes, digest hardlinks, writer exclusion, and
+auth-scope privacy.
+"""
+
+import hashlib
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from demodel_tpu.store import Store, key_for_uri
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = Store(tmp_path / "store")
+    yield s
+    s.close()
+
+
+def test_put_get_roundtrip(store):
+    body = b"hello content-addressed world" * 10
+    digest = store.put("abcd1234abcd1234", body, {"content-type": "x/y"})
+    assert digest == hashlib.sha256(body).hexdigest()
+    assert store.has("abcd1234abcd1234")
+    assert store.get("abcd1234abcd1234") == body
+    assert store.size("abcd1234abcd1234") == len(body)
+    meta = store.meta("abcd1234abcd1234")
+    assert meta["content-type"] == "x/y"
+    assert meta["sha256"] == digest
+
+
+def test_missing_key(store):
+    assert not store.has("0000000000000000")
+    assert store.size("0000000000000000") == -1
+    assert store.meta("0000000000000000") is None
+    with pytest.raises(KeyError):
+        store.get("0000000000000000")
+
+
+def test_key_matches_native(store):
+    """Python and C++ must derive identical URI keys — peers exchange them."""
+    import ctypes
+
+    from demodel_tpu import native
+
+    for uri in ("https://huggingface.co/gpt2/resolve/main/model.safetensors",
+                "http://127.0.0.1:8080/x?sig=1", "demodel://models/hf/gpt2"):
+        buf = ctypes.create_string_buffer(17)
+        native.lib().dm_key_for_uri(uri.encode(), buf)
+        assert buf.value.decode() == key_for_uri(uri)
+        assert len(key_for_uri(uri)) == 16
+
+
+def test_unsafe_keys_rejected(store):
+    for bad in ("../escape", "a/b", "", "x" * 200, "spaced key"):
+        with pytest.raises(OSError):
+            store.begin(bad)
+
+
+def test_streaming_write_and_resume(store):
+    body = np.random.default_rng(0).bytes(300_000)
+    w = store.begin("feedbeef00000001")
+    w.append(body[:100_000])
+    w.abort(keep_partial=True)
+    assert store.partial_size("feedbeef00000001") == 100_000
+    assert not store.has("feedbeef00000001")
+
+    w = store.begin("feedbeef00000001", resume=True)
+    assert w.offset == 100_000
+    w.append(body[100_000:])
+    assert w.digest() == hashlib.sha256(body).hexdigest()
+    w.commit({"size": len(body)})
+    assert store.get("feedbeef00000001") == body
+
+
+def test_mid_stream_digest_peek(store):
+    """digest() mid-stream must not disturb the running hash."""
+    w = store.begin("1234abcd1234abcd")
+    w.append(b"part one|")
+    peek = w.digest()
+    assert peek == hashlib.sha256(b"part one|").hexdigest()
+    w.append(b"part two")
+    assert w.digest() == hashlib.sha256(b"part one|part two").hexdigest()
+    w.commit({})
+    assert store.get("1234abcd1234abcd") == b"part one|part two"
+
+
+def test_large_body_stream(store):
+    body = np.random.default_rng(1).bytes(8 << 20)
+    store.put("baadf00d00000001", body, {})
+    got = b"".join(store.stream("baadf00d00000001", chunk=1 << 20))
+    assert got == body
+
+
+def test_range_reads(store):
+    body = bytes(range(256)) * 100
+    store.put("cafebabe00000001", body, {})
+    assert store.pread("cafebabe00000001", 100, 0) == body[:100]
+    assert store.pread("cafebabe00000001", 50, 1000) == body[1000:1050]
+    # read past end is truncated, not an error
+    assert store.pread("cafebabe00000001", 10_000, len(body) - 5) == body[-5:]
+
+
+def test_pread_into_numpy_buffer(store):
+    body = np.random.default_rng(2).bytes(100_000)
+    store.put("deadbeef00000001", body, {})
+    out = np.empty(40_000, np.uint8)
+    n = store.pread_into("deadbeef00000001", out, offset=30_000)
+    assert n == 40_000
+    assert out.tobytes() == body[30_000:70_000]
+
+
+def test_list_and_remove(store):
+    store.put("aaaa0000aaaa0000", b"a", {})
+    store.put("bbbb0000bbbb0000", b"b", {})
+    assert set(store.list()) == {"aaaa0000aaaa0000", "bbbb0000bbbb0000"}
+    store.remove("aaaa0000aaaa0000")
+    assert store.list() == ["bbbb0000bbbb0000"]
+    assert not store.has("aaaa0000aaaa0000")
+
+
+def test_commit_visible_across_instances(store, tmp_path):
+    body = b"cross-instance bytes"
+    store.put("cccc0000cccc0000", body, {"n": 1})
+    other = Store(tmp_path / "store")
+    try:
+        assert other.has("cccc0000cccc0000")
+        assert other.get("cccc0000cccc0000") == body
+        assert other.meta("cccc0000cccc0000")["n"] == 1
+    finally:
+        other.close()
+
+
+def test_index_sees_foreign_process_writes(store, tmp_path):
+    """The in-memory index revalidates against the objects dir, so writes
+    from another Store instance (process) become visible."""
+    assert store.index()["keys"] == []
+    other = Store(tmp_path / "store")
+    try:
+        other.put("dddd0000dddd0000", b"foreign", {})
+    finally:
+        other.close()
+    keys = {e["key"] for e in store.index()["keys"]}
+    assert "dddd0000dddd0000" in keys
+
+
+def test_concurrent_writer_guard(store):
+    w = store.begin("eeee0000eeee0000")
+    with pytest.raises(OSError, match="writer"):
+        store.begin("eeee0000eeee0000")
+    w.append(b"x")
+    w.commit({})
+    # guard released after commit
+    w2 = store.begin("eeee0000eeee0000")
+    w2.abort()
+
+
+def test_concurrent_distinct_keys(store):
+    """Writers on distinct keys proceed fully in parallel."""
+    bodies = {f"{i:016d}": np.random.default_rng(i).bytes(200_000)
+              for i in range(8)}
+    errs = []
+
+    def write_one(key, body):
+        try:
+            w = store.begin(key)
+            for off in range(0, len(body), 10_000):
+                w.append(body[off:off + 10_000])
+            w.commit({})
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=write_one, args=kv) for kv in bodies.items()]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs
+    for key, body in bodies.items():
+        assert store.get(key) == body
+
+
+# ------------------------------------------------------------ range writer
+
+
+def test_range_writer_parallel(store):
+    body = np.random.default_rng(3).bytes(1 << 20)
+    w = store.begin_ranged("ffff0000ffff0000", len(body))
+    slices = [(i * (len(body) // 4), (i + 1) * (len(body) // 4))
+              for i in range(4)]
+
+    def write_slice(a, b):
+        w.pwrite(body[a:b], a)
+
+    ts = [threading.Thread(target=write_slice, args=s) for s in slices]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert w.written == len(body)
+    digest = w.commit({}, expected_digest=hashlib.sha256(body).hexdigest())
+    assert digest == hashlib.sha256(body).hexdigest()
+    assert store.get("ffff0000ffff0000") == body
+
+
+def test_range_writer_incomplete_coverage_fails(store):
+    w = store.begin_ranged("1111000011110000", 1000)
+    w.pwrite(b"x" * 400, 0)  # gap at [400, 1000)
+    with pytest.raises(OSError):
+        w.commit({})
+    assert not store.has("1111000011110000")
+
+
+def test_range_writer_overlapping_retry(store):
+    """A retried (overlapping) range must not mask a real gap, and full
+    coverage with overlaps must commit cleanly."""
+    body = bytes(range(100))
+    w = store.begin_ranged("2222000022220000", 100)
+    w.pwrite(body[:60], 0)
+    w.pwrite(body[30:70], 30)   # overlap, still a gap at [70, 100)
+    assert w.written == 70
+    w2 = w
+    w2.pwrite(body[40:], 40)    # overlap + completes coverage
+    assert w2.written == 100
+    w2.commit({})
+    assert store.get("2222000022220000") == body
+
+
+def test_range_writer_out_of_bounds_rejected(store):
+    w = store.begin_ranged("3333000033330000", 100)
+    with pytest.raises(OSError):
+        w.pwrite(b"x" * 50, 80)   # would exceed total
+    with pytest.raises(OSError):
+        w.pwrite(b"x", -1)
+    w.abort()
+
+
+def test_range_writer_digest_mismatch(store):
+    import errno
+
+    body = b"not the advertised bytes" * 10
+    w = store.begin_ranged("4444000044440000", len(body))
+    w.pwrite(body, 0)
+    with pytest.raises(OSError) as ei:
+        w.commit({}, expected_digest="0" * 64)
+    assert ei.value.errno == errno.EBADMSG
+    assert not store.has("4444000044440000")
+
+
+def test_range_writer_respects_writer_guard(store):
+    w = store.begin_ranged("5555000055550000", 10)
+    with pytest.raises(OSError, match="writer"):
+        store.begin("5555000055550000")
+    with pytest.raises(OSError, match="writer"):
+        store.begin_ranged("5555000055550000", 10)
+    w.abort()
+    w2 = store.begin("5555000055550000")
+    w2.abort()
+
+
+# ------------------------------------------------------- content addressing
+
+
+def test_digest_link_and_materialize(store):
+    body = b"content addressed payload" * 50
+    digest = store.put("6666000066660000", body, {})
+    assert store.has_digest(digest)
+    store.materialize("7777000077770000", digest,
+                      {"via": "dedup", "sha256": digest})
+    assert store.get("7777000077770000") == body
+    assert store.meta("7777000077770000")["via"] == "dedup"
+
+
+def test_materialize_unknown_digest_fails(store):
+    with pytest.raises(OSError):
+        store.materialize("8888000088880000", "f" * 64, {})
+    assert not store.has("8888000088880000")
+
+
+def test_remove_reclaims_digest_when_last_ref(store):
+    body = b"last ref bytes"
+    digest = store.put("9999000099990000", body, {})
+    store.materialize("aaaa1111aaaa1111", digest, {"sha256": digest})
+    store.remove("9999000099990000")
+    assert store.has_digest(digest)  # second key still holds the bytes
+    store.remove("aaaa1111aaaa1111")
+    assert not store.has_digest(digest)
+
+
+def test_recommit_reclaims_old_digest(store):
+    d1 = store.put("bbbb1111bbbb1111", b"version one", {})
+    assert store.has_digest(d1)
+    store.remove("bbbb1111bbbb1111")
+    d2 = store.put("bbbb1111bbbb1111", b"version two", {})
+    assert store.has_digest(d2)
+    assert not store.has_digest(d1)
+
+
+def test_private_flag_from_auth_scope(store):
+    store.put("cccc1111cccc1111", b"private", {"auth_scope": "abc123"})
+    store.put("dddd1111dddd1111", b"public", {})
+    idx = {e["key"] for e in store.index()["keys"]}
+    assert "dddd1111dddd1111" in idx
+    assert "cccc1111cccc1111" not in idx       # never advertised to peers
+    assert "cccc1111cccc1111" in store.list()  # still locally visible
+
+
+def test_private_objects_not_content_addressed(store):
+    """Auth-scoped entries must stay out of the digest map — cross-user
+    dedup would leak private bytes to whoever guesses the hash."""
+    body = b"secret model bytes"
+    digest = hashlib.sha256(body).hexdigest()
+    store.put("eeee1111eeee1111", body, {"auth_scope": "tok"})
+    assert not store.has_digest(digest)
+    # same bytes cached publicly DO get content-addressed
+    store.put("ffff1111ffff1111", body, {})
+    assert store.has_digest(digest)
+    json.dumps(store.index())  # index stays serializable
