@@ -1,0 +1,210 @@
+// Native self-test: exercises the store and proxy data plane under the
+// sanitizers (ASan/UBSan, TSan targets in the Makefile; gated into pytest
+// via tests/test_native_selftest.py — SURVEY.md §5 "Race detection").
+//
+// Deliberately concurrency-heavy: parallel RangeWriter slices, concurrent
+// distinct-key writers, index readers racing committers, and proxy
+// start/serve/stop cycles — the shapes that found the r1 listener
+// shutdown race.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "proxy.h"
+#include "sha256.h"
+#include "store.h"
+
+static int failures = 0;
+
+#define CHECK(cond, msg)                                         \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      ::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, msg); \
+      failures++;                                                \
+    }                                                            \
+  } while (0)
+
+static std::string tmpdir() {
+  char buf[] = "/tmp/demodel-selftest-XXXXXX";
+  char *d = ::mkdtemp(buf);
+  return d ? d : "/tmp";
+}
+
+static void test_sha256() {
+  CHECK(dm::Sha256::hex_of("abc", 3) ==
+            "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad",
+        "sha256 vector");
+  dm::Sha256 s;
+  s.update("ab", 2);
+  std::string peek = s.hex();  // mid-stream peek must not disturb state
+  s.update("c", 1);
+  CHECK(peek == dm::Sha256::hex_of("ab", 2), "peek value");
+  CHECK(s.hex() == dm::Sha256::hex_of("abc", 3), "peek non-destructive");
+  CHECK(dm::key_for_uri("https://x/y").size() == 16, "key length");
+}
+
+static void test_store_basic(const std::string &root) {
+  std::string err;
+  dm::Store *s = dm::Store::open(root + "/basic", &err);
+  CHECK(s != nullptr, err.c_str());
+  std::string body(100000, 'x');
+  char digest[65] = {0};
+  CHECK(s->put("aaaa0000aaaa0000", body.data(), (int64_t)body.size(),
+               "{\"n\": 1}", digest) == 0, "put");
+  CHECK(s->has("aaaa0000aaaa0000"), "has");
+  CHECK(s->size("aaaa0000aaaa0000") == (int64_t)body.size(), "size");
+  CHECK(s->has_digest(digest), "digest link");
+  std::vector<char> buf(500);
+  CHECK(s->pread("aaaa0000aaaa0000", buf.data(), 500, 1000) == 500, "pread");
+  CHECK(::memcmp(buf.data(), body.data() + 1000, 500) == 0, "pread bytes");
+  CHECK(s->materialize("bbbb0000bbbb0000", digest, "{\"via\":\"dedup\"}") == 0,
+        "materialize");
+  CHECK(s->size("bbbb0000bbbb0000") == (int64_t)body.size(), "materialized");
+  // writer guard
+  dm::Writer *w = s->begin("cccc0000cccc0000", false, &err);
+  CHECK(w != nullptr, "begin");
+  CHECK(s->begin("cccc0000cccc0000", false, &err) == nullptr, "guard");
+  w->append("hi", 2);
+  CHECK(w->commit("{}") == 0, "commit");
+  delete w;
+  // private objects stay out of the index
+  s->put("dddd0000dddd0000", "secret", 6, "{\"auth_scope\":\"t\"}", nullptr);
+  CHECK(s->index_json().find("dddd0000dddd0000") == std::string::npos,
+        "private hidden");
+  CHECK(s->index_json().find("aaaa0000aaaa0000") != std::string::npos,
+        "public indexed");
+  delete s;
+}
+
+static void test_store_concurrent(const std::string &root) {
+  std::string err;
+  dm::Store *s = dm::Store::open(root + "/conc", &err);
+  CHECK(s != nullptr, "open conc");
+  // parallel RangeWriter slices on one preallocated partial
+  const int64_t total = 4 << 20;
+  std::string body(total, 0);
+  for (int64_t i = 0; i < total; i++) body[i] = (char)(i * 31 % 251);
+  dm::RangeWriter *rw = s->begin_ranged("eeee0000eeee0000", total, &err);
+  CHECK(rw != nullptr, "begin_ranged");
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; t++) {
+    ts.emplace_back([&, t] {
+      int64_t a = t * (total / 4), b = (t + 1) * (total / 4);
+      // write in small chunks to stress the coverage-merge lock
+      for (int64_t off = a; off < b; off += 65536) {
+        int64_t len = std::min<int64_t>(65536, b - off);
+        CHECK(rw->pwrite_at(body.data() + off, len, off) == 0, "pwrite");
+      }
+    });
+  }
+  for (auto &t : ts) t.join();
+  CHECK(rw->written() == total, "coverage");
+  char digest[65] = {0};
+  CHECK(rw->commit("{}", dm::Sha256::hex_of(body.data(), body.size()), digest)
+            == 0, "ranged commit + verify");
+  delete rw;
+  // concurrent distinct-key writers racing index readers
+  std::vector<std::thread> ws;
+  for (int t = 0; t < 4; t++) {
+    ws.emplace_back([&, t] {
+      char key[32];
+      ::snprintf(key, sizeof key, "f%02d0000ffff0000", t);
+      std::string payload(10000 + t, 'a' + t);
+      CHECK(s->put(key, payload.data(), (int64_t)payload.size(), "{}",
+                   nullptr) == 0, "concurrent put");
+    });
+  }
+  std::thread reader([&] {
+    for (int i = 0; i < 50; i++) {
+      (void)s->index_json();
+      (void)s->list_keys();
+    }
+  });
+  for (auto &t : ws) t.join();
+  reader.join();
+  delete s;
+}
+
+static void test_proxy_lifecycle(const std::string &root) {
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/proxystore";
+  cfg.verbose = false;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "proxy start");
+  int port = p->port();
+  CHECK(port > 0, "ephemeral port");
+
+  // origin-form /healthz round trip
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in addr = {};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  ::inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  CHECK(::connect(fd, (struct sockaddr *)&addr, sizeof addr) == 0, "connect");
+  const char *req = "GET /healthz HTTP/1.1\r\nHost: x\r\n\r\n";
+  CHECK(::write(fd, req, ::strlen(req)) == (ssize_t)::strlen(req), "send");
+  char buf[1024];
+  ssize_t n = ::read(fd, buf, sizeof buf - 1);
+  CHECK(n > 0, "healthz reply");
+  buf[n > 0 ? n : 0] = 0;
+  CHECK(::strstr(buf, "200 OK") != nullptr, "healthz 200");
+  ::close(fd);
+
+  // stop() with racing connections (the r1 shutdown-race shape)
+  std::vector<std::thread> cs;
+  for (int i = 0; i < 4; i++) {
+    cs.emplace_back([port] {
+      int cfd = ::socket(AF_INET, SOCK_STREAM, 0);
+      struct sockaddr_in a = {};
+      a.sin_family = AF_INET;
+      a.sin_port = htons((uint16_t)port);
+      ::inet_pton(AF_INET, "127.0.0.1", &a.sin_addr);
+      if (::connect(cfd, (struct sockaddr *)&a, sizeof a) == 0) {
+        const char *r = "GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n";
+        (void)!::write(cfd, r, ::strlen(r));
+        char b[256];
+        (void)::read(cfd, b, sizeof b);
+      }
+      ::close(cfd);
+    });
+  }
+  p->stop();
+  for (auto &t : cs) t.join();
+  delete p;
+
+  // start/stop cycles must not leak or race
+  for (int i = 0; i < 3; i++) {
+    dm::ProxyConfig c2;
+    c2.host = "127.0.0.1";
+    c2.port = 0;
+    c2.verbose = false;
+    auto *p2 = new dm::Proxy(std::move(c2));
+    CHECK(p2->start() == 0, "cycle start");
+    p2->stop();
+    delete p2;
+  }
+}
+
+int main() {
+  std::string root = tmpdir();
+  test_sha256();
+  test_store_basic(root);
+  test_store_concurrent(root);
+  test_proxy_lifecycle(root);
+  if (failures) {
+    ::fprintf(stderr, "%d failures\n", failures);
+    return 1;
+  }
+  ::printf("native selftest OK\n");
+  return 0;
+}

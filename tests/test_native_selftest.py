@@ -1,0 +1,42 @@
+"""Native data-plane selftest under the sanitizers (SURVEY.md §5 — the
+reference configures none; the rebuild gates ASan/UBSan and TSan into CI).
+"""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+NATIVE = Path(__file__).resolve().parent.parent / "native"
+
+
+def _run_selftest(target: str, env_extra: dict | None = None):
+    build = subprocess.run(["make", "-C", str(NATIVE), target],
+                           capture_output=True, text=True, timeout=600)
+    if build.returncode != 0:
+        pytest.fail(f"build {target} failed:\n{build.stdout}\n{build.stderr}")
+    binary = NATIVE / "build" / target
+    import os
+
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    run = subprocess.run([str(binary)], capture_output=True, text=True,
+                         timeout=600, env=env)
+    assert run.returncode == 0, \
+        f"{target} failed (rc={run.returncode}):\n{run.stdout}\n{run.stderr}"
+    assert "native selftest OK" in run.stdout
+
+
+def test_native_selftest():
+    _run_selftest("selftest")
+
+
+@pytest.mark.parametrize("san", ["asan", "tsan"])
+def test_native_selftest_sanitized(san):
+    env = {}
+    if san == "asan":
+        # dlopen'd libcrypto confuses LSan's suppression-free default run;
+        # intercept-heavy settings stay on, leak check stays on
+        env["ASAN_OPTIONS"] = "detect_leaks=1"
+        env["LSAN_OPTIONS"] = "suppressions=/dev/null"
+    _run_selftest(f"selftest-{san}", env)
