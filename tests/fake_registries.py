@@ -221,11 +221,21 @@ def build_ollama_model(seed: int = 1, blob_kb: int = 64) -> tuple[dict, dict[str
     return manifest, blobs
 
 
-def make_ollama_handler(models: dict[str, dict], blobs: dict[str, bytes]):
-    """Handler over {name:tag → manifest} + {digest → bytes}."""
+def make_ollama_handler(models: dict[str, dict], blobs: dict[str, bytes],
+                        require_token: bool = False):
+    """Handler over {name:tag → manifest} + {digest → bytes}.
+
+    ``require_token`` adds the registry token dance the real
+    ``registry.ollama.ai`` performs: anonymous /v2/ requests get a 401 with
+    ``WWW-Authenticate: Bearer realm=...``; the client fetches a token from
+    the realm and retries with ``Authorization: Bearer``. The token is
+    deterministic — the real registry also hands the same anonymous token
+    within its validity window, which is what lets the MITM proxy's
+    auth-scoped cache hit on re-pulls."""
 
     counts: dict[str, int] = {}
     lock = threading.Lock()
+    TOKEN = "anon-token-0123456789"
 
     class FakeOllamaHandler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -238,17 +248,50 @@ def make_ollama_handler(models: dict[str, dict], blobs: dict[str, bytes]):
             with lock:
                 counts[bucket] = counts.get(bucket, 0) + 1
 
-        def _send(self, status, body: bytes, ctype="application/json"):
+        def _send(self, status, body: bytes, ctype="application/json",
+                  extra=None):
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.send_header("Docker-Distribution-Api-Version", "registry/2.0")
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
             self.end_headers()
-            self.wfile.write(body)
+            if self.command != "HEAD":
+                self.wfile.write(body)
+
+        def _authed(self) -> bool:
+            if not require_token:
+                return True
+            return self.headers.get("Authorization") == f"Bearer {TOKEN}"
+
+        def _challenge(self):
+            self._count("challenge")
+            host = self.headers.get("Host", "registry")
+            self._send(401, b'{"errors":[{"code":"UNAUTHORIZED"}]}', extra={
+                "WWW-Authenticate":
+                    f'Bearer realm="https://{host}/token",'
+                    f'service="{host}",scope="repository:*:pull"'})
+
+        def do_HEAD(self):
+            self.do_GET()
 
         def do_GET(self):
+            if self.path.startswith("/token"):
+                self._count("token")
+                self._send(200, json.dumps({"token": TOKEN}).encode())
+                return
+            if self.path == "/v2/" or self.path == "/v2":
+                if not self._authed():
+                    self._challenge()
+                    return
+                self._send(200, b"{}")
+                return
             m = re.match(r"^/v2/(.+?)/manifests/([^/]+)$", self.path)
             if m:
+                if not self._authed():
+                    self._challenge()
+                    return
                 key = f"{m.group(1)}:{m.group(2)}"
                 self._count("manifest")
                 if key not in models:
@@ -259,6 +302,9 @@ def make_ollama_handler(models: dict[str, dict], blobs: dict[str, bytes]):
                 return
             m = re.match(r"^/v2/(.+?)/blobs/(sha256:[0-9a-f]{64})$", self.path)
             if m:
+                if not self._authed():
+                    self._challenge()
+                    return
                 self._count("blob")
                 body = blobs.get(m.group(2))
                 if body is None:

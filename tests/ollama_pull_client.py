@@ -1,0 +1,87 @@
+"""Client-faithful Ollama pull: the exact wire sequence ``ollama pull``
+performs against a Docker-registry-v2 registry (the reference's canonical
+runbook client, ``CONTRIBUTING.md:39-51``), as a standalone subprocess.
+
+Sequence: GET /v2/ ping → 401 challenge → token fetch from the advertised
+realm → manifest with Bearer → config + layer blobs by digest (Bearer),
+each sha256-verified. Proxying comes from the environment
+(``HTTPS_PROXY``/``REQUESTS_CA_BUNDLE``) exactly like the real client.
+
+Usage: python ollama_pull_client.py <registry_base_url> <name:tag> <dest>
+"""
+
+import hashlib
+import json
+import re
+import sys
+from pathlib import Path
+
+import requests
+
+
+def bearer_token(sess: requests.Session, base: str) -> str | None:
+    r = sess.get(f"{base}/v2/", timeout=30)
+    if r.status_code != 401:
+        return None
+    chal = r.headers.get("WWW-Authenticate", "")
+    m = re.search(r'realm="([^"]+)"', chal)
+    if not m:
+        raise SystemExit(f"401 without Bearer realm: {chal!r}")
+    svc = re.search(r'service="([^"]+)"', chal)
+    scope = re.search(r'scope="([^"]+)"', chal)
+    params = {}
+    if svc:
+        params["service"] = svc.group(1)
+    if scope:
+        params["scope"] = scope.group(1)
+    tr = sess.get(m.group(1), params=params, timeout=30)
+    tr.raise_for_status()
+    return tr.json()["token"]
+
+
+def main() -> int:
+    base, name_tag, dest = sys.argv[1], sys.argv[2], sys.argv[3]
+    name, _, tag = name_tag.partition(":")
+    if "/" not in name:
+        name = f"library/{name}"
+    tag = tag or "latest"
+    dest = Path(dest)
+    dest.mkdir(parents=True, exist_ok=True)
+
+    sess = requests.Session()
+    token = bearer_token(sess, base)
+    if token:
+        sess.headers["Authorization"] = f"Bearer {token}"
+
+    mr = sess.get(
+        f"{base}/v2/{name}/manifests/{tag}",
+        headers={"Accept":
+                 "application/vnd.docker.distribution.manifest.v2+json"},
+        timeout=60)
+    mr.raise_for_status()
+    manifest = mr.json()
+    assert manifest["schemaVersion"] == 2, manifest
+    (dest / "manifest.json").write_bytes(mr.content)
+
+    blobs = [manifest["config"]] + manifest.get("layers", [])
+    total = 0
+    for blob in blobs:
+        digest = blob["digest"]
+        algo, _, hexd = digest.partition(":")
+        assert algo == "sha256", digest
+        br = sess.get(f"{base}/v2/{name}/blobs/{digest}", timeout=300)
+        br.raise_for_status()
+        got = hashlib.sha256(br.content).hexdigest()
+        if got != hexd:
+            raise SystemExit(f"digest mismatch for {digest}: got {got}")
+        if "size" in blob and len(br.content) != blob["size"]:
+            raise SystemExit(f"size mismatch for {digest}")
+        (dest / hexd).write_bytes(br.content)
+        total += len(br.content)
+    print(json.dumps({"name": name, "tag": tag, "blobs": len(blobs),
+                      "bytes": total}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -264,3 +264,87 @@ def test_init_installs_system_trust_curl_no_cacert(tmp_path, monkeypatch):
                     missing_ok=True)
                 sp.run(["update-ca-certificates", "--fresh"],
                        capture_output=True, timeout=120)
+
+
+# ---------------------- round-3: registry-v2 (Ollama) through the proxy
+
+
+def _ollama_env(proxy) -> dict:
+    ca = str(pki.ca_paths(proxy.cfg.data_dir)[0])
+    env = dict(os.environ)
+    env.update({
+        "HTTPS_PROXY": f"http://127.0.0.1:{proxy.port}",
+        "HTTP_PROXY": f"http://127.0.0.1:{proxy.port}",
+        "REQUESTS_CA_BUNDLE": ca,
+        "CURL_CA_BUNDLE": ca,
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("NO_PROXY", None)
+    env.pop("no_proxy", None)
+    return env
+
+
+@pytest.fixture()
+def ollama_rig(tmp_path):
+    """(registry, proxy, manifest, blobs, handler) — TLS registry-v2 fake
+    (token dance ON, the registry.ollama.ai shape) behind the MITM proxy."""
+    from .fake_registries import build_ollama_model, make_ollama_handler
+
+    manifest, blobs = build_ollama_model(blob_kb=256)
+    handler = make_ollama_handler({"library/tiny:latest": manifest}, blobs,
+                                  require_token=True)
+    with FakeUpstream(handler=handler, tls_dir=tmp_path / "regca") as reg:
+        cfg = ProxyConfig(
+            host="127.0.0.1", port=0, mitm_hosts=[reg.authority],
+            cache_dir=tmp_path / "cache", data_dir=tmp_path / "data",
+            use_ecdsa=True,
+        )
+        with ProxyServer(cfg, upstream_ca=str(reg.ca_path),
+                         verbose=False) as proxy:
+            yield reg, proxy, manifest, blobs, handler
+
+
+def test_ollama_registry_v2_through_proxy(ollama_rig, tmp_path):
+    """BASELINE config 2 at the proxy layer: the exact ollama-pull wire
+    sequence (ping → 401 → token → manifest → blobs-by-digest, all with
+    Bearer) rides HTTPS_PROXY through the MITM; a second pull moves zero
+    blob bytes upstream (reference runbook ``CONTRIBUTING.md:39-51``,
+    golden manifest schema ``CONTRIBUTING.md:128-153``)."""
+    reg, proxy, manifest, blobs, handler = ollama_rig
+    client = Path(__file__).parent / "ollama_pull_client.py"
+    env = _ollama_env(proxy)
+
+    d1 = tmp_path / "pull1"
+    _run([sys.executable, str(client), f"https://{reg.authority}",
+          "tiny:latest", str(d1)], env)
+    for digest, body in blobs.items():
+        assert (d1 / digest.split(":")[1]).read_bytes() == body
+    blobs_upstream = handler.request_counts.get("blob", 0)
+    assert blobs_upstream == len(blobs)
+
+    # second pull, fresh dest: blob bytes come from the proxy cache
+    d2 = tmp_path / "pull2"
+    _run([sys.executable, str(client), f"https://{reg.authority}",
+          "tiny:latest", str(d2)], env)
+    for digest, body in blobs.items():
+        assert (d2 / digest.split(":")[1]).read_bytes() == body
+    assert handler.request_counts.get("blob", 0) == blobs_upstream, \
+        "re-pull moved blob bytes upstream — proxy cache bypassed"
+    m = proxy.metrics()
+    assert m["mitm"] >= 2 and m["cache_hits"] >= len(blobs)
+
+
+def test_ollama_offline_replay_after_registry_death(ollama_rig, tmp_path):
+    """Warm proxy + dead registry: the full registry-v2 flow (including the
+    token endpoint and manifest) replays from cache."""
+    reg, proxy, manifest, blobs, handler = ollama_rig
+    client = Path(__file__).parent / "ollama_pull_client.py"
+    env = _ollama_env(proxy)
+    _run([sys.executable, str(client), f"https://{reg.authority}",
+          "tiny:latest", str(tmp_path / "warm")], env)
+    reg.stop()
+    dead = tmp_path / "offline"
+    _run([sys.executable, str(client), f"https://{reg.authority}",
+          "tiny:latest", str(dead)], env)
+    for digest, body in blobs.items():
+        assert (dead / digest.split(":")[1]).read_bytes() == body
