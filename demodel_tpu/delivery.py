@@ -62,6 +62,20 @@ def pull(
     return report
 
 
+def _maybe_gc(store: Store) -> None:
+    """Enforce the cache size cap after a pull (``DEMODEL_CACHE_MAX_GB``;
+    0 = unbounded). The native proxy enforces the same knob on its serving
+    loop; this covers first-party pull traffic."""
+    from demodel_tpu.utils.env import env_int
+
+    max_gb = env_int("DEMODEL_CACHE_MAX_GB", 0)
+    if max_gb > 0:
+        total, freed, evicted = store.gc(max_gb << 30)
+        if evicted:
+            log.info("cache gc: evicted %d objects (%.1f MB); %.1f MB in use",
+                     evicted, freed / 1e6, total / 1e6)
+
+
 def _persist_manifest(store: Store, mkey: str, out: dict,
                       failed_keys: set[str]) -> None:
     """Write the model-manifest record, omitting files whose cache commit
@@ -206,6 +220,7 @@ def pull_to_hbm(
                     placed.integrity_errors = list(fetcher.integrity_failures)
                     _persist_manifest(store, mkey, out,
                                       {k for k, _ in fails})
+                    _maybe_gc(store)
                 except BaseException as e:  # noqa: BLE001 — surfaced at finalize()
                     placed.finalize_error = e
                 finally:
@@ -224,6 +239,7 @@ def pull_to_hbm(
             # record must not reference keys that never hit the store
             fails = reg.fetcher.flush_writes()
             _persist_manifest(store, mkey, out, {k for k, _ in fails})
+            _maybe_gc(store)
             if reg.fetcher.integrity_failures:
                 # optimistic verify found the delivered bytes corrupt —
                 # the placement is poisoned; fail the pull

@@ -73,6 +73,8 @@ def _configure(L: ctypes.CDLL) -> None:
     sig("dm_store_begin_ranged", P, [P, CP, I64, CP, I])
     sig("dm_store_index_json", I, [P, CP, I])
     sig("dm_store_list", I, [P, CP, I])
+    sig("dm_store_gc", I64, [P, I64, c.POINTER(I64), c.POINTER(I)])
+    sig("dm_store_evictions", I64, [P])
     sig("dm_key_for_uri", None, [CP, CP])
     # streaming writer
     sig("dm_writer_append", I, [P, P, I64])

@@ -75,6 +75,7 @@ class ProxyServer:
             1 if verbose else 0,
             io_timeout_sec,
             env_int("DEMODEL_MAX_BODY_MB", max_body_mb),
+            env_int("DEMODEL_CACHE_MAX_GB", 0) << 10,  # → MB; 0 = unbounded
         )
         if not self._h:
             raise OSError("proxy allocation failed")
@@ -87,6 +88,7 @@ class ProxyServer:
         L.dm_proxy_new.argtypes = [
             c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_char_p,
             c.c_char_p, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_int64,
+            c.c_int64,
         ]
         L.dm_proxy_new.restype = c.c_void_p
         L.dm_proxy_start.argtypes = [c.c_void_p]

@@ -69,6 +69,9 @@ def render(proxy=None, store=None) -> str:
             lines.append("# TYPE demodel_store_bytes gauge")
             lines.append(
                 f"demodel_store_bytes {sum(e.get('size', 0) for e in idx)}")
+            lines.append("# TYPE demodel_store_evictions_total counter")
+            lines.append(
+                f"demodel_store_evictions_total {store.evictions_total()}")
         except Exception:  # noqa: BLE001
             pass
     return "\n".join(lines) + "\n"

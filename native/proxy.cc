@@ -508,6 +508,7 @@ class Session {
       RequestHead req;
       if (!parse_request_head(&client_, &req)) return;
       if (!serve_one(req, "https", authority, host, port, /*tls=*/true)) return;
+      p_->maybe_gc();
       std::string conn = lower(req.headers.get("connection"));
       if (conn == "close") return;
     }
@@ -608,6 +609,7 @@ class Session {
       std::string authority = host + ":" + std::to_string(port);
       req.target = path;
       if (!serve_one(req, "http", authority, host, port, /*tls=*/false)) return;
+      p_->maybe_gc();
       if (lower(req.headers.get("connection")) == "close") return;
       RequestHead next;
       if (!parse_request_head(&client_, &next)) return;
@@ -1715,6 +1717,20 @@ SSL_CTX *Proxy::leaf_ctx(const std::string &host, std::string *err) {
   return ctx;
 }
 
+void Proxy::maybe_gc() {
+  // Size-cap enforcement rides the serving loop, rate-limited: a full
+  // objects/ scan every request would hurt the hot path, and eviction has
+  // 10% hysteresis anyway (store.cc) so periodic passes are enough.
+  if (cfg_.cache_max_bytes <= 0 || !store_) return;
+  if (gc_tick_.fetch_add(1) % 16 != 15) return;
+  int64_t freed = 0;
+  int evicted = 0;
+  store_->gc(cfg_.cache_max_bytes, &freed, &evicted);
+  if (evicted > 0 && cfg_.verbose)
+    ::fprintf(stderr, "[demodel-tpu] cache gc: evicted %d objects (%lld bytes)\n",
+              evicted, (long long)freed);
+}
+
 SSL_CTX *Proxy::upstream_ctx() {
   std::lock_guard<std::mutex> g(upstream_mu_);
   if (upstream_ctx_) return upstream_ctx_;
@@ -2088,7 +2104,8 @@ extern "C" {
 void *dm_proxy_new(const char *host, int port, int mitm_all, int no_mitm,
                    const char *hosts_csv, const char *store_root,
                    const char *upstream_ca, int cache_enabled, void *mint_cb,
-                   int verbose, int io_timeout_sec, int64_t max_body_mb) {
+                   int verbose, int io_timeout_sec, int64_t max_body_mb,
+                   int64_t cache_max_mb) {
   dm::ProxyConfig cfg;
   cfg.host = host ? host : "127.0.0.1";
   cfg.port = port;
@@ -2112,6 +2129,7 @@ void *dm_proxy_new(const char *host, int port, int mitm_all, int no_mitm,
   cfg.verbose = verbose != 0;
   if (io_timeout_sec > 0) cfg.io_timeout_sec = io_timeout_sec;
   if (max_body_mb > 0) cfg.max_body_bytes = max_body_mb << 20;
+  if (cache_max_mb > 0) cfg.cache_max_bytes = cache_max_mb << 20;
   return new dm::Proxy(std::move(cfg));
 }
 

@@ -45,6 +45,7 @@ struct ProxyConfig {
   bool verbose = false;
   int io_timeout_sec = 75;
   int64_t max_body_bytes = 64ll << 20;  // request-body cap (413 beyond)
+  int64_t cache_max_bytes = 0;  // 0 = unbounded; else LRU gc target
 };
 
 struct Metrics {
@@ -86,6 +87,9 @@ class Proxy {
 
   // signed-CDN digest hints: a 302's X-Linked-Etag recorded against the
   // redirect target lets the next fresh-signature URL dedup by content
+  // rate-limited size-cap enforcement (runs store_->gc)
+  void maybe_gc();
+
   void record_hint(const std::string &authority, const std::string &location,
                    const std::string &digest);
   std::string hint_digest(const std::string &authority,
@@ -116,6 +120,7 @@ class Proxy {
   int listen_fd_ = -1;
   int port_ = 0;
   std::thread accept_thread_;
+  std::atomic<uint64_t> gc_tick_{0};
 };
 
 }  // namespace dm

@@ -608,6 +608,82 @@ std::string Store::index_json() {
   return out;
 }
 
+int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
+                  int *evicted_count) {
+  if (freed_bytes) *freed_bytes = 0;
+  if (evicted_count) *evicted_count = 0;
+  std::lock_guard<std::mutex> gcg(gc_mu_);
+
+  struct Entry {
+    std::string key;
+    int64_t size;
+    int64_t recency_ns;
+    ino_t ino;
+    nlink_t nlink;
+  };
+  std::vector<Entry> entries;
+  std::set<ino_t> seen_inodes;  // digest hardlinks: count bytes once
+  int64_t total = 0;
+  DIR *d = ::opendir((root_ + "/objects").c_str());
+  if (!d) return -errno;
+  struct dirent *e;
+  while ((e = ::readdir(d)) != nullptr) {
+    std::string name = e->d_name;
+    if (name == "." || name == "..") continue;
+    if (name.size() > 5 && name.compare(name.size() - 5, 5, ".meta") == 0)
+      continue;
+    if (name.size() > 4 && (name.compare(name.size() - 4, 4, ".tmp") == 0 ||
+                            name.compare(name.size() - 4, 4, ".lnk") == 0))
+      continue;
+    struct stat st;
+    if (::stat(obj_path(name).c_str(), &st) != 0 || !S_ISREG(st.st_mode))
+      continue;
+    int64_t at = (int64_t)st.st_atim.tv_sec * 1000000000 + st.st_atim.tv_nsec;
+    int64_t mt = (int64_t)st.st_mtim.tv_sec * 1000000000 + st.st_mtim.tv_nsec;
+    entries.push_back({name, (int64_t)st.st_size, std::max(at, mt),
+                       st.st_ino, st.st_nlink});
+    if (seen_inodes.insert(st.st_ino).second) total += (int64_t)st.st_size;
+  }
+  ::closedir(d);
+  if (max_bytes <= 0 || total <= max_bytes) return total;
+
+  // oldest first; hysteresis to 90% so back-to-back publishes don't thrash
+  std::sort(entries.begin(), entries.end(),
+            [](const Entry &a, const Entry &b) {
+              return a.recency_ns < b.recency_ns;
+            });
+  int64_t target = max_bytes - max_bytes / 10;
+  for (const Entry &en : entries) {
+    if (total <= target) break;
+    {
+      std::lock_guard<std::mutex> g(writers_mu_);
+      if (active_writers_.count(en.key)) continue;  // never an active key
+    }
+    std::string old_meta = meta(en.key);
+    if (!old_meta.empty()) drop_digest_ref(en.key, old_meta);
+    if (::unlink(obj_path(en.key).c_str()) != 0 && errno != ENOENT) continue;
+    ::unlink(meta_path(en.key).c_str());
+    // partials are NOT touched: a resumable download survives eviction
+    {
+      std::lock_guard<std::mutex> g(fd_mu_);
+      auto it = fd_cache_.find(en.key);
+      if (it != fd_cache_.end()) {
+        ::close(it->second);
+        fd_cache_.erase(it);
+      }
+    }
+    // bytes only come back when the LAST link to the inode goes away
+    if (en.nlink <= 2) {  // objects/<key> + possibly digests/<sha>
+      total -= en.size;
+      if (freed_bytes) *freed_bytes += en.size;
+    }
+    if (evicted_count) (*evicted_count)++;
+    evictions_total_++;
+  }
+  invalidate_index();
+  return total;
+}
+
 std::string Store::list_keys() {
   std::string out;
   DIR *d = ::opendir((root_ + "/objects").c_str());
@@ -791,6 +867,17 @@ int dm_rw_commit(void *w, const char *meta_json, const char *expected_digest,
                       expected_digest ? expected_digest : "", digest_out);
   delete rw;
   return rc;
+}
+
+
+int64_t dm_store_gc(void *h, int64_t max_bytes, int64_t *freed_bytes,
+                    int *evicted_count) {
+  return static_cast<dm::Store *>(h)->gc(max_bytes, freed_bytes,
+                                         evicted_count);
+}
+
+int64_t dm_store_evictions(void *h) {
+  return static_cast<dm::Store *>(h)->evictions_total();
 }
 
 void dm_rw_abort(void *w, int keep_partial) {

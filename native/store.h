@@ -14,6 +14,7 @@
 //   digests/<sha256>     hardlink to an objects/<key> holding those bytes
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <map>
 #include <mutex>
@@ -128,6 +129,16 @@ class Store {
   int materialize(const std::string &key, const std::string &digest,
                   const std::string &meta_json);
 
+  // Size-capped LRU garbage collection over objects/ (neither reference
+  // generation had one — a pod-host cache that can only grow is not
+  // operable). Evicts least-recently-used committed objects (recency =
+  // max(atime, mtime); hardlinked digest copies count once) until total
+  // bytes fit under ~90% of max_bytes. Active writers' keys and partials
+  // are never touched, so resumable downloads survive. Returns the
+  // resulting total byte count; out-params report freed bytes / count.
+  int64_t gc(int64_t max_bytes, int64_t *freed_bytes, int *evicted_count);
+  int64_t evictions_total() const { return evictions_total_; }
+
   // -- paths (used by writers and the proxy's fill-attach reader)
   std::string obj_path(const std::string &key) const;
   std::string meta_path(const std::string &key) const;
@@ -160,6 +171,9 @@ class Store {
   std::mutex index_mu_;
   std::string index_cache_;
   int64_t index_mtime_ns_ = -1;  // objects/ dir mtime when cache was built
+
+  std::mutex gc_mu_;  // one GC pass at a time
+  std::atomic<int64_t> evictions_total_{0};
 };
 
 // peer DCN fetch (implemented in proxy.cc — shares Conn/http plumbing)
