@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import os
 import threading
 
 from demodel_tpu import native, pki
@@ -76,6 +77,10 @@ class ProxyServer:
             io_timeout_sec,
             env_int("DEMODEL_MAX_BODY_MB", max_body_mb),
             env_int("DEMODEL_CACHE_MAX_GB", 0) << 10,  # → MB; 0 = unbounded
+            0 if os.environ.get("DEMODEL_RANGED_FILL", "").strip().lower()
+            in ("0", "false", "no", "off") else 1,
+            env_int("DEMODEL_FILL_MAX_MB", 512),
+            env_int("DEMODEL_FILL_MIN_PCT", 5),
         )
         if not self._h:
             raise OSError("proxy allocation failed")
@@ -88,7 +93,7 @@ class ProxyServer:
         L.dm_proxy_new.argtypes = [
             c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_char_p,
             c.c_char_p, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_int64,
-            c.c_int64,
+            c.c_int64, c.c_int, c.c_int64, c.c_int,
         ]
         L.dm_proxy_new.restype = c.c_void_p
         L.dm_proxy_start.argtypes = [c.c_void_p]

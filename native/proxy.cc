@@ -724,7 +724,8 @@ class Session {
     // (VERDICT r1 missing #4; "proxied and cached, automatically",
     // CONTRIBUTING.md:51).
     std::string range = (cacheable && is_get) ? req.headers.get("range") : "";
-    if (!range.empty() && parse_single_range(range, nullptr, nullptr)) {
+    if (!range.empty() && p_->cfg_.ranged_fill &&
+        parse_single_range(range, nullptr, nullptr)) {
       int served = serve_ranged_miss_fill(req, uri, key, auth_scope, authority,
                                           host, port, tls);
       if (served >= 0) return served != 0;
@@ -931,6 +932,38 @@ class Session {
     if (!satisfiable) {
       off = 0;
       len = 0;
+    }
+
+    // fill policy: a full-object pull is only justified when the object is
+    // small enough, or the client's window covers enough of it that the
+    // extra bytes are marginal. Otherwise drop this upstream exchange (the
+    // head is read, the body is abandoned) and forward the ORIGINAL ranged
+    // request uncached — the window's bytes move, nothing else.
+    bool policy_ok =
+        (p_->cfg_.fill_max_bytes > 0 && size <= p_->cfg_.fill_max_bytes) ||
+        (satisfiable &&
+         len * 100 >= size * (int64_t)p_->cfg_.fill_min_cover_pct);
+    if (!policy_ok) {
+      w->abort(false);
+      delete w;
+      finish_fill(false);
+      upstream_.shutdown_close();
+      upstream_authority_.clear();
+      if (!ensure_upstream(authority, host, port, tls) ||
+          !send_upstream_request(req, "")) {
+        p_->metrics_.errors++;
+        send_simple(&client_, 502, "Bad Gateway", "upstream connect failed");
+        return 0;
+      }
+      ResponseHead ranged_resp;
+      if (!parse_response_head(&upstream_, &ranged_resp)) {
+        p_->metrics_.errors++;
+        send_simple(&client_, 502, "Bad Gateway", "upstream read failed");
+        return 0;
+      }
+      bool keep = stream_response(req, ranged_resp, uri, key,
+                                  /*cacheable=*/false, auth_scope);
+      return keep ? 1 : 0;
     }
 
     // header arrived: publish the total so attached readers can resolve
@@ -2105,7 +2138,8 @@ void *dm_proxy_new(const char *host, int port, int mitm_all, int no_mitm,
                    const char *hosts_csv, const char *store_root,
                    const char *upstream_ca, int cache_enabled, void *mint_cb,
                    int verbose, int io_timeout_sec, int64_t max_body_mb,
-                   int64_t cache_max_mb) {
+                   int64_t cache_max_mb, int ranged_fill,
+                   int64_t fill_max_mb, int fill_min_pct) {
   dm::ProxyConfig cfg;
   cfg.host = host ? host : "127.0.0.1";
   cfg.port = port;
@@ -2130,6 +2164,9 @@ void *dm_proxy_new(const char *host, int port, int mitm_all, int no_mitm,
   if (io_timeout_sec > 0) cfg.io_timeout_sec = io_timeout_sec;
   if (max_body_mb > 0) cfg.max_body_bytes = max_body_mb << 20;
   if (cache_max_mb > 0) cfg.cache_max_bytes = cache_max_mb << 20;
+  cfg.ranged_fill = ranged_fill != 0;
+  if (fill_max_mb >= 0) cfg.fill_max_bytes = fill_max_mb << 20;
+  if (fill_min_pct >= 0) cfg.fill_min_cover_pct = fill_min_pct;
   return new dm::Proxy(std::move(cfg));
 }
 

@@ -495,3 +495,86 @@ def test_stop_during_active_transfer(rig):
     assert time.time() - t0 < 10, "stop() hung on a live transfer"
     t.join(timeout=10)
     assert not t.is_alive()
+
+
+# ------------------------- round-3: ranged-miss fill policy (VERDICT #7)
+
+
+def _policy_rig(tmp_path, monkeypatch, **env):
+    for var in ("REQUESTS_CA_BUNDLE", "CURL_CA_BUNDLE"):
+        monkeypatch.delenv(var, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    _Handler.hits = {}
+    up = FakeUpstream(handler=_Handler, tls_dir=tmp_path / "hubca").start()
+    cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[up.authority],
+                      cache_dir=tmp_path / "cache", data_dir=tmp_path / "data",
+                      use_ecdsa=True)
+    proxy = ProxyServer(cfg, upstream_ca=str(up.ca_path), verbose=False)
+    proxy.start()
+    s = requests.Session()
+    s.proxies = {"https": f"http://127.0.0.1:{proxy.port}"}
+    s.verify = str(pki.ca_paths(cfg.data_dir)[0])
+    return s, up, proxy, f"https://{up.authority}"
+
+
+def test_small_range_on_large_object_does_not_fill(tmp_path, monkeypatch):
+    """A tiny probe of an object past the fill ceiling must NOT trigger a
+    full-object pull: the ranged request passes through, nothing caches."""
+    s, up, proxy, base = _policy_rig(
+        tmp_path, monkeypatch,
+        DEMODEL_FILL_MAX_MB="0", DEMODEL_FILL_MIN_PCT="50")
+    try:
+        r = s.get(f"{base}/blob", headers={"Range": "bytes=0-1023"},
+                  timeout=30)
+        assert r.status_code == 206 and r.content == _BODY[:1024]
+        assert r.headers.get("X-Demodel-Cache") == "MISS"  # pass-through
+        # a later full GET must go upstream — nothing was cached
+        r2 = s.get(f"{base}/blob", timeout=30)
+        assert r2.headers.get("X-Demodel-Cache") == "MISS"
+        assert _Handler.hits["/blob"] >= 2
+        store = Store(tmp_path / "cache" / "proxy")
+        try:
+            assert all(len(store.get(k)) != len(_BODY) for k in store.list())
+        finally:
+            store.close()
+    finally:
+        proxy.stop()
+        up.stop()
+
+
+def test_covering_range_still_fills(tmp_path, monkeypatch):
+    """A window covering more than the coverage threshold justifies the
+    fill even past the size ceiling."""
+    s, up, proxy, base = _policy_rig(
+        tmp_path, monkeypatch,
+        DEMODEL_FILL_MAX_MB="0", DEMODEL_FILL_MIN_PCT="50")
+    try:
+        n = int(len(_BODY) * 0.6)
+        r = s.get(f"{base}/blob", headers={"Range": f"bytes=0-{n - 1}"},
+                  timeout=30)
+        assert r.status_code == 206 and r.content == _BODY[:n]
+        assert r.headers.get("X-Demodel-Cache") in ("FILL", "FILL-ATTACH")
+        import time as _t
+
+        _t.sleep(0.3)
+        r2 = s.get(f"{base}/blob", timeout=30)
+        assert r2.headers.get("X-Demodel-Cache") == "HIT"
+        assert _Handler.hits["/blob"] == 1
+    finally:
+        proxy.stop()
+        up.stop()
+
+
+def test_ranged_fill_disable_knob(tmp_path, monkeypatch):
+    s, up, proxy, base = _policy_rig(
+        tmp_path, monkeypatch, DEMODEL_RANGED_FILL="off")
+    try:
+        r = s.get(f"{base}/blob", headers={"Range": "bytes=0-99"}, timeout=30)
+        assert r.status_code == 206 and r.content == _BODY[:100]
+        assert r.headers.get("X-Demodel-Cache") == "MISS"
+        r2 = s.get(f"{base}/blob", timeout=30)  # still cold
+        assert r2.headers.get("X-Demodel-Cache") == "MISS"
+    finally:
+        proxy.stop()
+        up.stop()
