@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from demodel_tpu.formats import gguf as gguf_mod
 from demodel_tpu.formats import safetensors as st
@@ -99,6 +99,10 @@ def _slices_contiguous_rows(idx: tuple, shape: tuple[int, ...]) -> tuple[int, in
     return None
 
 
+def _fully_replicated(sharding: NamedSharding) -> bool:
+    return all(p is None for p in sharding.spec) or len(sharding.spec) == 0
+
+
 def place_tensor(
     read_at,
     shape: tuple[int, ...],
@@ -107,6 +111,7 @@ def place_tensor(
     sharding: NamedSharding,
     cast_to=None,
     read_into=None,
+    ici_complete: bool = False,
 ) -> jax.Array:
     """Build a sharded global array reading only per-device byte ranges.
 
@@ -116,8 +121,27 @@ def place_tensor(
     tensor, sliced per device. When ``read_into(offset, out_buffer)`` is
     given, range reads land straight in the numpy buffer handed to
     ``device_put`` — one copy instead of two.
+
+    ``ici_complete`` (SURVEY.md §2.3 "Intra-pod shard exchange"): a
+    REPLICATED tensor on a multi-host mesh would make every host read every
+    byte over disk/DCN. Instead each host loads only its 1/N of the rows
+    (staged row-sharded) and an XLA all-gather over ICI completes the
+    replicas — each byte crosses the slow path exactly once.
     """
     itemsize = np.dtype(np_dtype).itemsize
+    mesh = sharding.mesh
+    n_total = int(np.prod(list(mesh.shape.values()), dtype=np.int64))
+    if (ici_complete and _fully_replicated(sharding) and shape
+            and shape[0] % n_total == 0
+            and int(np.prod(shape, dtype=np.int64)) * itemsize
+            >= 4096 * n_total):
+        stage = NamedSharding(
+            mesh, P(tuple(mesh.axis_names), *([None] * (len(shape) - 1))))
+        staged = place_tensor(read_at, shape, np_dtype, start, stage,
+                              cast_to, read_into=read_into)
+        from demodel_tpu.parallel.collectives import redistribute
+
+        return redistribute(staged, sharding)
     row_bytes = int(np.prod(shape[1:], dtype=np.int64)) * itemsize if shape else itemsize
     dev_map = sharding.addressable_devices_indices_map(shape)
 
@@ -162,6 +186,19 @@ def place_tensor(
 # ------------------------------------------------------------- safetensors
 
 
+def _ici_complete_default() -> bool:
+    """On multi-host runs, replicated tensors complete over ICI by default
+    (each host reads 1/N); DEMODEL_ICI_COMPLETE forces either way."""
+    import os
+
+    env = os.environ.get("DEMODEL_ICI_COMPLETE", "").strip().lower()
+    if env in ("1", "true", "yes", "on"):
+        return True
+    if env in ("0", "false", "no", "off"):
+        return False
+    return jax.process_count() > 1
+
+
 def deliver_safetensors(
     store: Store,
     key: str,
@@ -169,6 +206,7 @@ def deliver_safetensors(
     plan: ShardingPlan | None = None,
     cast_to=None,
     buffer=None,
+    ici_complete: bool | None = None,
 ) -> Placement:
     """Land every tensor of a stored safetensors blob in HBM, sharded.
 
@@ -190,13 +228,15 @@ def deliver_safetensors(
         read_at = lambda off, ln: store.pread(key, ln, off)  # noqa: E731
         read_into = lambda off, out: store.pread_into(key, out, off)  # noqa: E731
         index = st.read_index_from(read_at, total_size=store.size(key))
+    if ici_complete is None:
+        ici_complete = _ici_complete_default()
     out = Placement(mesh_desc=f"{dict(mesh.shape)}")
     for name, spec in index.tensors.items():
         np_dtype = _np_dtype(spec.dtype)
         sharding = plan.sharding_for(name, spec.shape, np_dtype.itemsize)
         out.arrays[name] = place_tensor(
             read_at, spec.shape, np_dtype, spec.start, sharding, cast_to,
-            read_into=read_into,
+            read_into=read_into, ici_complete=ici_complete,
         )
     return out
 

@@ -1,0 +1,122 @@
+"""Two-process delivery proof (VERDICT r2 #3): two OS processes sharing one
+jax.distributed 8-device mesh deliver a checkpoint; each host reads ONLY
+its shards' bytes (the test FAILS if either host reads the full
+checkpoint), replicated tensors complete over the mesh all-gather, and
+cross-host fingerprints agree."""
+
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from demodel_tpu.formats import safetensors as st
+from demodel_tpu.store import Store
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def checkpoint(tmp_path):
+    """A store holding one blob: tp-shardable weights + a large replicated
+    tensor (the ICI-completion target)."""
+    rng = np.random.default_rng(0)
+    tensors = {
+        "blocks.0.w": rng.standard_normal((256, 128)).astype(np.float32),
+        "blocks.1.w": rng.standard_normal((256, 128)).astype(np.float32),
+        # plan replicates this (1-D can't shard on tp under the plan), and
+        # it is big + row-divisible → the ici_complete staging kicks in
+        "replicated.big": rng.standard_normal((512, 64)).astype(np.float32),
+    }
+    blob = st.serialize(tensors)
+    root = tmp_path / "shared-store"
+    s = Store(root)
+    s.put("twohostckpt00001", blob, {})
+    s.close()
+    return root, "twohostckpt00001", tensors, blob
+
+
+def _run_workers(root, key, mode):
+    import os
+
+    port = _free_port()
+    worker = Path(__file__).parent / "two_host_worker.py"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i), str(port), str(root), key,
+         mode],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    return outs
+
+
+def test_two_processes_split_the_read_bytes(checkpoint):
+    """tp mesh: every tensor shards; each host reads only its shards."""
+    root, key, tensors, _ = checkpoint
+    outs = _run_workers(root, key, "tp")
+    total_weight_bytes = sum(a.nbytes for a in tensors.values())
+    for o in outs:
+        # THE core assertion: a host that read the full checkpoint fails
+        assert o["bytes_read"] < total_weight_bytes, \
+            f"host {o['pid']} read {o['bytes_read']} of " \
+            f"{total_weight_bytes} — full-checkpoint read"
+        assert o["bytes_read"] <= total_weight_bytes * 0.55
+    # both hosts together read each byte exactly once
+    assert sum(o["bytes_read"] for o in outs) == total_weight_bytes
+    # cross-host placement fingerprints agree tensor-for-tensor
+    assert outs[0]["fp"] == outs[1]["fp"]
+
+
+def test_replicated_completion_over_collectives(checkpoint):
+    """dp mesh (SURVEY §2.3 intra-pod shard exchange): every host needs
+    FULL replicas, yet each reads only half the bytes — the mesh
+    all-gather moves the other half. Fails if either host reads it all."""
+    root, key, tensors, _ = checkpoint
+    outs = _run_workers(root, key, "dp")
+    total_weight_bytes = sum(a.nbytes for a in tensors.values())
+    for o in outs:
+        assert o["bytes_read"] < total_weight_bytes, \
+            f"host {o['pid']} read everything — ICI completion inactive"
+        assert o["bytes_read"] <= total_weight_bytes * 0.55
+    assert sum(o["bytes_read"] for o in outs) == total_weight_bytes
+    assert outs[0]["fp"] == outs[1]["fp"]
+    # replicas are complete and source-exact on BOTH hosts
+    want_sum = float(tensors["replicated.big"].astype(np.float64).sum())
+    for o in outs:
+        assert o["rep_shape"] == [512, 64]
+        assert abs(o["rep_local_sum"] - want_sum) < 1e-6 * max(
+            1.0, abs(want_sum))
+
+
+def test_ici_complete_parity_single_process(checkpoint, mesh8):
+    """The ici_complete staging path must be value-identical to the naive
+    replicated load (single-process mechanics check)."""
+    root, key, tensors, _ = checkpoint
+    from demodel_tpu.sink.hbm import deliver_safetensors
+
+    s = Store(root)
+    try:
+        naive = deliver_safetensors(s, key, mesh=mesh8, ici_complete=False)
+        staged = deliver_safetensors(s, key, mesh=mesh8, ici_complete=True)
+        for name in tensors:
+            np.testing.assert_array_equal(np.asarray(naive.arrays[name]),
+                                          np.asarray(staged.arrays[name]))
+            assert (staged.arrays[name].sharding.spec
+                    == naive.arrays[name].sharding.spec)
+    finally:
+        s.close()
