@@ -139,10 +139,12 @@ def pull_to_hbm(
     handed_off = False  # True once the background finalizer owns flush+close
     t0 = time.perf_counter()
     try:
+        buffer_budget = None
         if deliver:
             from demodel_tpu.sink.streaming import StreamingSink
 
             sink_worker = StreamingSink(store, mesh=mesh)
+            buffer_budget = sink_worker.budget
 
         if sink_worker is not None:
             _sink = sink_worker
@@ -170,6 +172,7 @@ def pull_to_hbm(
                 ca=cfg.upstream_ca,
                 peers=peer_set,
                 memory_sink=memory_sink,
+                buffer_budget=buffer_budget,
             )
             report = reg.pull(model, revision=revision, on_file=on_file)
         elif source == "ollama":
@@ -181,6 +184,7 @@ def pull_to_hbm(
                 ca=cfg.upstream_ca,
                 peers=peer_set,
                 memory_sink=memory_sink,
+                buffer_budget=buffer_budget,
             )
             report = reg.pull(model, on_file=on_file)
         else:

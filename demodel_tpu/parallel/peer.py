@@ -184,7 +184,7 @@ class PeerSet:
             return False
 
     def fetch_to_memory(self, key: str, expected_digest: str | None = None,
-                        eager_verify: bool = True):
+                        eager_verify: bool = True, budget=None):
         """Fetch ``key`` (located by key or content digest) from a peer
         straight into a host landing buffer — the zero-disk leg of
         cold-pull→HBM. Returns ``(numpy uint8 buffer, peer_meta)`` or
@@ -227,17 +227,29 @@ class PeerSet:
             return None
         want = expected_digest or peer_meta.get("sha256") or ""
         host, port = m.group(1).strip("[]"), int(m.group(2) or 80)
-        buf = np.empty(size, dtype=np.uint8)
-        errbuf = ctypes.create_string_buffer(512)
-        n = native.lib().dm_peer_fetch_into(
-            host.encode(), port, f"/peer/object/{remote_key}".encode(),
-            size, _peer_streams(), (want if eager_verify else "").encode(),
-            buf.ctypes.data_as(ctypes.c_void_p), errbuf, 512,
-        )
-        if n != size:
-            log.warning("peer memory fetch of %s from %s failed: %s", key,
-                        peer, errbuf.value.decode(errors="replace"))
-            return None
+        if budget is not None:
+            # host RAM is committed HERE — the budget gates allocation, not
+            # just queue admission, so concurrent fetches of huge shards
+            # wait before touching memory
+            budget.acquire(size)
+        try:
+            buf = np.empty(size, dtype=np.uint8)
+            errbuf = ctypes.create_string_buffer(512)
+            n = native.lib().dm_peer_fetch_into(
+                host.encode(), port, f"/peer/object/{remote_key}".encode(),
+                size, _peer_streams(), (want if eager_verify else "").encode(),
+                buf.ctypes.data_as(ctypes.c_void_p), errbuf, 512,
+            )
+            if n != size:
+                log.warning("peer memory fetch of %s from %s failed: %s", key,
+                            peer, errbuf.value.decode(errors="replace"))
+                if budget is not None:
+                    budget.release(size)
+                return None
+        except BaseException:
+            if budget is not None:
+                budget.release(size)
+            raise
         return buf, peer_meta
 
     def _native_fetch(self, store: Store, peer: str, key: str,

@@ -45,6 +45,9 @@ class FileArtifact:
     #: host landing buffer (memory-first peer fetch) — consumed by the HBM
     #: sink; never serialized into reports
     buffer: object = None
+    #: True when the buffer's bytes were charged against the delivery's
+    #: shared ByteBudget at allocation (the sink releases them on landing)
+    budget_charged: bool = False
 
 
 @dataclass
@@ -66,7 +69,8 @@ class PullReport:
             "revision": self.revision,
             "total_bytes": self.total_bytes,
             "secs": round(self.secs, 3),
-            "files": [{k: v for k, v in vars(f).items() if k != "buffer"}
+            "files": [{k: v for k, v in vars(f).items()
+                       if k not in ("buffer", "budget_charged")}
                       for f in self.files],
         }
 
@@ -79,7 +83,7 @@ class Fetcher:
 
     def __init__(self, store: Store, ca: str | None = None,
                  proxies: dict | None = None, headers: dict | None = None,
-                 peers=None, memory_sink: bool = False):
+                 peers=None, memory_sink: bool = False, buffer_budget=None):
         self.store = store
         # per-request verify (not Session.verify): a REQUESTS_CA_BUNDLE /
         # CURL_CA_BUNDLE env var silently overrides the session attribute
@@ -89,6 +93,10 @@ class Fetcher:
         #: straight to the HBM sink; the cache copy commits off the
         #: delivery critical path (join via flush_writes)
         self.memory_sink = memory_sink
+        #: demodel_tpu.sink.streaming.ByteBudget shared with the sink —
+        #: landing-buffer allocation blocks HERE, so N fetch workers can
+        #: never pin N full shards (the r3 scale-test finding)
+        self.buffer_budget = buffer_budget
         self._proxies = dict(proxies or {})
         self._headers = dict(headers or {})
         self._tls = threading.local()
@@ -337,7 +345,8 @@ class Fetcher:
         if (not self.store.has(key) and self.peers is not None
                 and self.memory_sink):
             got = self.peers.fetch_to_memory(key, expected_digest=expected_digest,
-                                             eager_verify=self._verify_eager())
+                                             eager_verify=self._verify_eager(),
+                                             budget=self.buffer_budget)
             if got is not None:
                 buf, peer_meta = got
                 digest = expected_digest or peer_meta.get("sha256", "")
@@ -348,6 +357,7 @@ class Fetcher:
                     name=name, uri=url, key=key, size=len(buf), sha256=digest,
                     media_type=media_type, etag=peer_meta.get("etag", ""),
                     from_peer=True, secs=time.perf_counter() - t0, buffer=buf,
+                    budget_charged=self.buffer_budget is not None,
                 )
         if not self.store.has(key) and self.peers is not None:
             # DCN-first: a pod peer that already holds the bytes beats the

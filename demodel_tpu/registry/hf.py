@@ -40,13 +40,15 @@ class HFRegistry:
         proxies: dict | None = None,
         peers=None,
         memory_sink: bool = False,
+        buffer_budget=None,
     ):
         self.endpoint = endpoint.rstrip("/")
         headers = {"User-Agent": "demodel-tpu/0.1"}
         if token:
             headers["Authorization"] = f"Bearer {token}"
         self.fetcher = Fetcher(store, ca=ca, proxies=proxies, headers=headers,
-                               peers=peers, memory_sink=memory_sink)
+                               peers=peers, memory_sink=memory_sink,
+                               buffer_budget=buffer_budget)
 
     # -- API ------------------------------------------------------------
     def repo_info(self, repo_id: str, revision: str = "main") -> dict:

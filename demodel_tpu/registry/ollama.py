@@ -43,12 +43,14 @@ class OllamaRegistry:
         proxies: dict | None = None,
         peers=None,
         memory_sink: bool = False,
+        buffer_budget=None,
     ):
         self.endpoint = endpoint.rstrip("/")
         self.fetcher = Fetcher(
             store, ca=ca, proxies=proxies,
             headers={"User-Agent": "demodel-tpu/0.1"},
             peers=peers, memory_sink=memory_sink,
+            buffer_budget=buffer_budget,
         )
 
     # -- registry-v2 URL shapes -----------------------------------------

@@ -230,6 +230,11 @@ def deliver_safetensors(
         index = st.read_index_from(read_at, total_size=store.size(key))
     if ici_complete is None:
         ici_complete = _ici_complete_default()
+    if buffer is not None:
+        # memory-first delivery: the FULL file is already in this host's
+        # RAM, so a staged load + all-gather would re-move bytes the host
+        # has — the ICI leg only pays when reads hit the slow path
+        ici_complete = False
     out = Placement(mesh_desc=f"{dict(mesh.shape)}")
     for name, spec in index.tensors.items():
         np_dtype = _np_dtype(spec.dtype)
@@ -342,13 +347,19 @@ def is_weight_file(name: str, media_type: str = "") -> bool:
 
 
 def deliver_file(store: Store, name: str, key: str, mesh: Mesh,
-                 plan: ShardingPlan, cast_to=None, buffer=None) -> Placement:
+                 plan: ShardingPlan, cast_to=None, buffer=None,
+                 ici_complete: bool | None = None) -> Placement:
     """Deliver one weight file (dispatch by format). Shared by the
     non-streaming and streaming sinks so dispatch rules never diverge.
-    ``buffer`` short-circuits the store read (memory-first delivery)."""
+    ``buffer`` short-circuits the store read (memory-first delivery).
+
+    The STREAMING sink must pass ``ici_complete=False``: its per-file
+    delivery order follows fetch completion, which differs across hosts,
+    and multi-controller collectives pair by launch order — only ordered
+    delivery passes (:func:`deliver_report_to_hbm`) may use the ICI leg."""
     if name.endswith(".safetensors"):
         return deliver_safetensors(store, key, mesh, plan, cast_to,
-                                   buffer=buffer)
+                                   buffer=buffer, ici_complete=ici_complete)
     return deliver_gguf(store, key, mesh, plan, buffer=buffer)
 
 

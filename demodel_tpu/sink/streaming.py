@@ -44,6 +44,47 @@ log = get_logger("sink.streaming")
 _DONE = object()
 
 
+class ByteBudget:
+    """Counting semaphore in BYTES for landing buffers.
+
+    Shared between the fetcher (charges at buffer ALLOCATION — the moment
+    host RAM is actually committed) and the streaming sink (releases once
+    the buffer's tensors are resident on device). Waiting happens at
+    allocation, so N fetch workers cannot pin N full shards regardless of
+    queue bounds. A single item larger than the budget is admitted alone
+    rather than deadlocking.
+    """
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._in_use = 0
+        self._cv = threading.Condition()
+        self._aborted = False
+
+    @property
+    def in_use(self) -> int:
+        with self._cv:
+            return self._in_use
+
+    def acquire(self, nbytes: int) -> None:
+        with self._cv:
+            while (self._in_use > 0 and self._in_use + nbytes > self.max_bytes
+                   and not self._aborted):
+                self._cv.wait(0.2)
+            self._in_use += nbytes
+
+    def release(self, nbytes: int) -> None:
+        with self._cv:
+            self._in_use -= nbytes
+            self._cv.notify_all()
+
+    def abort(self) -> None:
+        """Unblock all waiters (error path — delivery is being abandoned)."""
+        with self._cv:
+            self._aborted = True
+            self._cv.notify_all()
+
+
 class _Cancelled(Exception):
     """Internal sentinel: drain the queue without delivering."""
 
@@ -59,7 +100,8 @@ class StreamingSink:
     def __init__(self, store: Store, mesh: Mesh | None = None,
                  plan: ShardingPlan | None = None, cast_to=None,
                  overlap: bool | None = None,
-                 max_buffered_bytes: int | None = None):
+                 max_buffered_bytes: int | None = None,
+                 budget: ByteBudget | None = None):
         self.store = store
         self.mesh = mesh if mesh is not None else make_mesh()
         self.plan = plan if plan is not None else ShardingPlan(self.mesh)
@@ -79,9 +121,10 @@ class StreamingSink:
         if max_buffered_bytes is None:
             max_buffered_bytes = env_int("DEMODEL_SINK_BUFFER_MB", 1024,
                                          minimum=1) << 20
-        self.max_buffered = max_buffered_bytes
-        self._buffered = 0  # admitted-but-undelivered landing-buffer bytes
-        self._cv = threading.Condition()  # guards _buffered; woken on drain/err
+        #: shared with the fetcher when delivery wires one (charging then
+        #: happens at buffer allocation); standalone sinks charge at submit
+        self.budget = budget if budget is not None else ByteBudget(
+            max_buffered_bytes)
         self._worker = None
         self._worker_lock = threading.Lock()
         if overlap:
@@ -106,6 +149,12 @@ class StreamingSink:
         media = (artifact.media_type if hasattr(artifact, "media_type")
                  else artifact.get("media_type", ""))
         if not is_weight_file(name, media):
+            # a charged buffer the sink will never consume (config/tokenizer
+            # fetched memory-first) returns its budget immediately
+            skipped = getattr(artifact, "buffer", None)
+            if skipped is not None and getattr(artifact, "budget_charged",
+                                               False):
+                self.budget.release(int(skipped.nbytes))
             return
         key = artifact.key if hasattr(artifact, "key") else artifact["key"]
         buffer = getattr(artifact, "buffer", None)
@@ -115,14 +164,10 @@ class StreamingSink:
             # (no-overlap) mode would otherwise hold every landing buffer
             # until finish() — the unbounded-RAM failure mode
             self._start_worker()
-            with self._cv:
-                # always admit at least one buffer (a single shard larger
-                # than the budget must pass, not deadlock)
-                while (self._buffered > 0
-                       and self._buffered + nbytes > self.max_buffered
-                       and self._get_err() is None):
-                    self._cv.wait(0.2)
-                self._buffered += nbytes
+            if not getattr(artifact, "budget_charged", False):
+                # standalone producers charge here; fetchers sharing the
+                # budget charged at allocation (the earlier, correct point)
+                self.budget.acquire(nbytes)
         self._q.put((name, key, buffer, nbytes))
 
     # ---- consumer side
@@ -130,8 +175,7 @@ class StreamingSink:
         with self._err_lock:
             if self._err is None:
                 self._err = e
-        with self._cv:
-            self._cv.notify_all()  # unblock backpressured producers
+        self.budget.abort()  # unblock backpressured producers
 
     def _get_err(self) -> BaseException | None:
         with self._err_lock:
@@ -147,9 +191,13 @@ class StreamingSink:
                 if self._get_err() is not None:
                     continue  # drain without working after first failure
                 try:
+                    # ici_complete=False: delivery order here follows fetch
+                    # completion, which is NOT synchronized across hosts —
+                    # a cross-host collective from this thread would pair
+                    # with a different tensor's collective on another host
                     placed = deliver_file(self.store, name, key, self.mesh,
                                           self.plan, self.cast_to,
-                                          buffer=buffer)
+                                          buffer=buffer, ici_complete=False)
                     merge_placement(self.placement, placed)
                     log.debug("streamed %s → %d tensors", name,
                               len(placed.arrays))
@@ -157,9 +205,7 @@ class StreamingSink:
                     self._set_err(e)
             finally:
                 if nbytes:
-                    with self._cv:
-                        self._buffered -= nbytes
-                        self._cv.notify_all()
+                    self.budget.release(nbytes)
 
     def cancel(self) -> None:
         """Abandon delivery: drain queued files without doing the work.

@@ -1,0 +1,62 @@
+"""Cold-pull worker for the checkpoint-scale test: pulls a multi-GB
+12-shard model from a warm peer and reports ITS OWN peak RSS and fd usage
+(run as a subprocess so the numbers are the pull's, not the harness').
+
+Usage: scale_pull_worker.py <hub_endpoint> <peer_url> <cache_dir> <mode>
+mode: "store" (fetch → content-addressed store) | "hbm" (memory-first →
+sharded CPU-device arrays).
+Prints JSON: {"rss_hwm": bytes, "fds": n, "secs": s, "total_bytes": n}
+"""
+
+import json
+import os
+import sys
+import time
+
+hub, peer, cache_dir, mode = sys.argv[1:5]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+# tight budgets: the RSS assertion proves they hold at checkpoint scale
+os.environ.setdefault("DEMODEL_SINK_BUFFER_MB", "256")
+os.environ.setdefault("DEMODEL_COMMIT_BACKLOG_MB", "256")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pathlib import Path  # noqa: E402
+
+from demodel_tpu import delivery  # noqa: E402
+from demodel_tpu.config import ProxyConfig  # noqa: E402
+
+
+def vm_hwm() -> int:
+    for line in open("/proc/self/status"):
+        if line.startswith("VmHWM:"):
+            return int(line.split()[1]) * 1024
+    return -1
+
+
+cfg = ProxyConfig(cache_dir=Path(cache_dir), data_dir=Path(cache_dir) / "d")
+t0 = time.perf_counter()
+if mode == "store":
+    report = delivery.pull("bench/scale", cfg, endpoint=hub, peers=[peer])
+    placed = None
+else:
+    report, placed = delivery.pull_to_hbm(
+        "bench/scale", cfg, endpoint=hub, peers=[peer],
+        defer_cache_commit=True)
+    placed.finalize()
+secs = time.perf_counter() - t0
+
+print(json.dumps({
+    "rss_hwm": vm_hwm(),
+    "fds": len(os.listdir("/proc/self/fd")),
+    "secs": round(secs, 2),
+    "total_bytes": report["total_bytes"],
+    "tensors": len(placed.arrays) if placed is not None else 0,
+    "from_peer": sum(1 for f in report["files"] if f.get("from_peer")),
+}), flush=True)

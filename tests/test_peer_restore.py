@@ -630,9 +630,11 @@ def test_sink_backpressure_bounds_buffered_bytes(mesh8, tmp_path, monkeypatch):
     observed = []
     orig = streaming_mod.deliver_file
 
-    def slow_deliver(store, name, key, mesh, plan, cast_to=None, buffer=None):
+    def slow_deliver(store, name, key, mesh, plan, cast_to=None, buffer=None,
+                     ici_complete=None):
         _t.sleep(0.05)  # hold the consumer so producers hit the budget
-        return orig(store, name, key, mesh, plan, cast_to, buffer=buffer)
+        return orig(store, name, key, mesh, plan, cast_to, buffer=buffer,
+                    ici_complete=ici_complete)
 
     monkeypatch.setattr(streaming_mod, "deliver_file", slow_deliver)
     store = Store(tmp_path / "s")
@@ -642,7 +644,7 @@ def test_sink_backpressure_bounds_buffered_bytes(mesh8, tmp_path, monkeypatch):
 
         def sample():
             while not sampler_stop.is_set():
-                observed.append(sink._buffered)
+                observed.append(sink.budget.in_use)
                 _t.sleep(0.005)
 
         th.Thread(target=sample, daemon=True).start()
