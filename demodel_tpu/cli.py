@@ -205,6 +205,9 @@ def _cmd_serve(cfg: ProxyConfig, args) -> int:
     try:
         store = open_store(cfg)
         registry = RestoreRegistry(store)
+        # tensor BYTES serve from the C++ plane on the proxy port; the
+        # Python server remains the control plane (manifests, models, PUT)
+        registry.attach_native(proxy)
         restore = RestoreServer(registry, host=cfg.host,
                                 port=args.restore_port, proxy=proxy)
         restore.start()

@@ -106,6 +106,10 @@ class ProxyServer:
         L.dm_proxy_free.restype = None
         L.dm_proxy_metrics.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
         L.dm_proxy_metrics.restype = c.c_int
+        L.dm_proxy_register_tensor.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_char_p, c.c_int64, c.c_int64,
+        ]
+        L.dm_proxy_register_tensor.restype = None
         L._proxy_sigs_done = True
 
     # -- lifecycle -------------------------------------------------------
@@ -123,6 +127,16 @@ class ProxyServer:
     def url(self) -> str:
         host = "127.0.0.1" if self.cfg.host in ("0.0.0.0", "") else self.cfg.host
         return f"http://{host}:{self.port}"
+
+    def register_tensor(self, model: str, tensor: str, key: str,
+                        start: int, nbytes: int) -> None:
+        """Expose a tensor byte window on the native restore data plane
+        (``GET /restore/{model}/tensor/{tensor}`` on the proxy port, range-
+        aware, sendfile-served). The Python restore server registers its
+        models here when attached — control plane in Python, bytes in C++."""
+        self._lib.dm_proxy_register_tensor(
+            self._h, f"{model}/{tensor}".encode(), key.encode(),
+            start, nbytes)
 
     def metrics(self) -> dict:
         buf = ctypes.create_string_buffer(1024)

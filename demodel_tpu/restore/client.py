@@ -56,11 +56,13 @@ def restore(
 
     out = RestoreResult(mesh_desc=f"{dict(mesh.shape)}", manifest=manifest)
     fetched = 0
+    # bytes ride the native data plane when the node advertises one
+    data_base = manifest.get("data_endpoint", endpoint).rstrip("/")
     for name, info in manifest["tensors"].items():
         shape = tuple(info["shape"])
         np_dtype = _np_dtype(info["dtype"])
         sharding = plan.sharding_for(name, shape, np_dtype.itemsize)
-        url = f"{endpoint}/restore/{model}/tensor/{name}"
+        url = f"{data_base}/restore/{model}/tensor/{name}"
 
         def read_at(off, ln, url=url):
             nonlocal fetched

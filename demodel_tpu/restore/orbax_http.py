@@ -154,10 +154,10 @@ class HTTPRestoreCheckpointHandler(ocp.CheckpointHandler):
 
     # -- restore --------------------------------------------------------
     def _restore_one(self, model: str, name: str, info: dict, sharding,
-                     cast_to) -> jax.Array:
+                     cast_to, data_base: str | None = None) -> jax.Array:
         shape = tuple(info["shape"])
         np_dtype = _np_dtype(info["dtype"])
-        url = f"{self.endpoint}/restore/{model}/tensor/{name}"
+        url = f"{data_base or self.endpoint}/restore/{model}/tensor/{name}"
 
         def read_at(off, ln):
             rr = self._session.get(
@@ -217,12 +217,15 @@ class HTTPRestoreCheckpointHandler(ocp.CheckpointHandler):
             ]
 
         flat: dict[str, jax.Array] = {}
+        data_base = manifest.get("data_endpoint")
+        if data_base:
+            data_base = data_base.rstrip("/")
         # tensor-level fan-out: restores are many independent range reads,
         # so a small pool hides HTTP latency; device_put is thread-safe
         with ThreadPoolExecutor(max_workers=min(self.workers, max(1, len(jobs)))) as ex:
             futs = {
                 ex.submit(self._restore_one, args.model, name, info,
-                          sharding, cast): name
+                          sharding, cast, data_base): name
                 for name, info, sharding, cast in jobs
             }
             for fut, name in futs.items():

@@ -586,6 +586,28 @@ class Session {
           req = next;
           continue;
         }
+        if (req.target.rfind("/restore/", 0) == 0 && p_->store_) {
+          // native restore data plane: /restore/{model}/tensor/{name}
+          // serves a registered tensor's byte window straight off the
+          // store fd (sendfile for plain clients) — the Python restore
+          // server stays the control plane that registered the mapping
+          auto tpos = req.target.find("/tensor/");
+          if (tpos != std::string::npos) {
+            std::string model = req.target.substr(9, tpos - 9);
+            std::string tensor = req.target.substr(tpos + 8);
+            TensorLoc loc;
+            if (!p_->lookup_tensor(model + "/" + tensor, &loc) ||
+                !p_->store_->has(loc.key)) {
+              send_simple(&client_, 404, "Not Found", "no such tensor");
+              return;
+            }
+            if (!serve_tensor_window(req, loc)) return;
+            RequestHead next;
+            if (!parse_request_head(&client_, &next)) return;
+            req = next;
+            continue;
+          }
+        }
         send_simple(&client_, 400, "Bad Request",
                     "this is an HTTP proxy; use it via HTTP(S)_PROXY");
         return;
@@ -1513,6 +1535,69 @@ class Session {
     return client_ok && upstream_ok;
   }
 
+  // Serve a registered tensor window [loc.start, loc.start+loc.nbytes) of a
+  // stored blob, honoring single-range requests within the window.
+  bool serve_tensor_window(const RequestHead &req, const TensorLoc &loc) {
+    int64_t off = 0, len = loc.nbytes;
+    int status = 200;
+    std::string range = req.headers.get("range");
+    int64_t rs = 0, re = -1;
+    if (!range.empty() && parse_single_range(range, &rs, &re)) {
+      if (!resolve_range(rs, re, loc.nbytes, &off, &len)) {
+        send_simple(&client_, 416, "Range Not Satisfiable");
+        return true;
+      }
+      status = 206;
+    }
+    std::string head = "HTTP/1.1 " + std::to_string(status) +
+                       (status == 206 ? " Partial Content" : " OK") + "\r\n";
+    head += "Content-Type: application/octet-stream\r\n";
+    head += cors_headers(req);
+    head += "Content-Length: " + std::to_string(len) + "\r\n";
+    if (status == 206)
+      head += "Content-Range: bytes " + std::to_string(off) + "-" +
+              std::to_string(off + len - 1) + "/" +
+              std::to_string(loc.nbytes) + "\r\n";
+    head += "Accept-Ranges: bytes\r\nConnection: keep-alive\r\n\r\n";
+    if (!client_.write_all(head.data(), head.size())) return false;
+    if (req.method == "HEAD") return true;
+
+    int64_t abs_off = loc.start + off;
+    if (!client_.ssl) {
+      int fd = p_->store_->open_read_fd(loc.key);
+      if (fd >= 0) {
+        off_t pos = abs_off;
+        int64_t sent = 0;
+        bool ok = true;
+        while (sent < len) {
+          size_t want = static_cast<size_t>(
+              std::min<int64_t>(len - sent, 4ll << 20));
+          ssize_t n = ::sendfile(client_.fd, fd, &pos, want);
+          if (n < 0 && errno == EINTR) continue;
+          if (n <= 0) {
+            ok = false;
+            break;
+          }
+          sent += n;
+          p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
+        }
+        ::close(fd);
+        return ok;
+      }
+    }
+    std::vector<char> buf(1 << 20);
+    int64_t sent = 0;
+    while (sent < len) {
+      int64_t want = std::min<int64_t>(len - sent, (int64_t)buf.size());
+      int64_t n = p_->store_->pread(loc.key, buf.data(), want, abs_off + sent);
+      if (n <= 0) return false;
+      if (!client_.write_all(buf.data(), static_cast<size_t>(n))) return false;
+      sent += n;
+      p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
+    }
+    return true;
+  }
+
   // Serve a committed cache object, honoring single-range requests.
   bool serve_from_cache(const RequestHead &req, const std::string &uri,
                         const std::string &key) {
@@ -1748,6 +1833,19 @@ SSL_CTX *Proxy::leaf_ctx(const std::string &host, std::string *err) {
   }
   leaf_ctxs_[host] = ctx;
   return ctx;
+}
+
+void Proxy::register_tensor(const std::string &model_tensor, TensorLoc loc) {
+  std::lock_guard<std::mutex> g(restore_mu_);
+  restore_map_[model_tensor] = std::move(loc);
+}
+
+bool Proxy::lookup_tensor(const std::string &model_tensor, TensorLoc *out) {
+  std::lock_guard<std::mutex> g(restore_mu_);
+  auto it = restore_map_.find(model_tensor);
+  if (it == restore_map_.end()) return false;
+  if (out) *out = it->second;
+  return true;
 }
 
 void Proxy::maybe_gc() {
@@ -2227,6 +2325,17 @@ int64_t dm_peer_fetch_into(const char *host, int port, const char *path,
     errbuf[m] = 0;
   }
   return n;
+}
+
+void dm_proxy_register_tensor(void *p, const char *model_tensor,
+                              const char *key, int64_t start,
+                              int64_t nbytes) {
+  dm::TensorLoc loc;
+  loc.key = key ? key : "";
+  loc.start = start;
+  loc.nbytes = nbytes;
+  static_cast<dm::Proxy *>(p)->register_tensor(
+      model_tensor ? model_tensor : "", std::move(loc));
 }
 
 int dm_proxy_metrics(void *p, char *buf, int buflen) {

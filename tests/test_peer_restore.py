@@ -814,3 +814,66 @@ def test_eager_verify_rejects_peer_and_heals_from_upstream(tmp_path, mesh8,
         np.testing.assert_array_equal(
             np.asarray(placed.arrays["layer.0.w"]),
             spec.to_numpy(good[spec.start:spec.end]))
+
+
+# --------------------- round-3: native restore data plane (VERDICT #6)
+
+
+def test_native_restore_data_plane(pulled_node, mesh8, tmp_path):
+    """Tensor bytes serve from the C++ proxy plane once attached: byte-
+    exact vs the Python server, range-aware, and the restore client + the
+    manifest's data_endpoint route bytes there automatically."""
+    store, report = pulled_node
+    registry = RestoreRegistry(store)
+    registry.register_report("org/m", report)
+
+    cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                      cache_dir=store.root.parent,
+                      data_dir=tmp_path / "np-data", use_ecdsa=True)
+    with ProxyServer(cfg, verbose=False) as proxy:
+        registry.attach_native(proxy)
+        with RestoreServer(registry, host="127.0.0.1", proxy=proxy) as srv:
+            py = f"http://127.0.0.1:{srv.port}"
+            manifest = requests.get(f"{py}/restore/org/m/manifest",
+                                    timeout=10).json()
+            assert manifest["data_endpoint"] == proxy.url
+
+            for name in ("layer.0.w", "layer.0.b"):
+                want = requests.get(f"{py}/restore/org/m/tensor/{name}",
+                                    timeout=10).content
+                got = requests.get(f"{proxy.url}/restore/org/m/tensor/{name}",
+                                   timeout=10)
+                assert got.status_code == 200 and got.content == want
+                # ranges on the native plane
+                part = requests.get(
+                    f"{proxy.url}/restore/org/m/tensor/{name}",
+                    headers={"Range": "bytes=8-23"}, timeout=10)
+                assert part.status_code == 206
+                assert part.content == want[8:24]
+                assert part.headers["Content-Range"] == \
+                    f"bytes 8-23/{len(want)}"
+            # unknown tensor → native 404
+            assert requests.get(f"{proxy.url}/restore/org/m/tensor/ghost",
+                                timeout=10).status_code == 404
+            # 416 past the window
+            n = manifest["tensors"]["layer.0.b"]["nbytes"]
+            assert requests.get(
+                f"{proxy.url}/restore/org/m/tensor/layer.0.b",
+                headers={"Range": f"bytes={n}-"},
+                timeout=10).status_code == 416
+
+            # the client restores THROUGH the data plane (bytes counted by
+            # the native metrics, values exact)
+            before = proxy.metrics()["bytes_cache"]
+            result = restore(py, "org/m", mesh=mesh8)
+            assert len(result.arrays) == 4
+            assert proxy.metrics()["bytes_cache"] > before
+            stf = next(f for f in report["files"]
+                       if f["name"].endswith("00001-of-00002.safetensors"))
+            idx = st.read_index_from(
+                lambda off, ln: store.pread(stf["key"], ln, off))
+            spec = idx.tensors["layer.0.w"]
+            src = spec.to_numpy(store.pread(stf["key"], spec.nbytes,
+                                            spec.start))
+            np.testing.assert_array_equal(
+                np.asarray(result.arrays["layer.0.w"]), src)
