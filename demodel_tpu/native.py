@@ -92,6 +92,8 @@ def _configure(L: ctypes.CDLL) -> None:
     sig("dm_peer_fetch_parallel", I64,
         [P, CP, I, CP, CP, I64, I, CP, CP, CP, I])
     sig("dm_peer_fetch_into", I64, [CP, I, CP, I64, I, CP, P, CP, I])
+    sig("dm_upstream_fetch_parallel", I64,
+        [P, CP, I, I, CP, CP, CP, I64, I, CP, CP, CP, I])
     # proxy prototypes are configured in demodel_tpu.proxy (its call sites)
 
 

@@ -2074,19 +2074,44 @@ int64_t peer_fetch(Store *store, const std::string &host, int port,
 static int peer_fetch_slice(const std::string &host, int port,
                             const std::string &path, int64_t a, int64_t b,
                             int64_t total, char *direct, RangeWriter *rw,
-                            std::string *err) {
+                            std::string *err, SSL_CTX *tls_ctx = nullptr,
+                            const std::string &host_header = "") {
   int fd = tcp_connect(host, port, 30, err);
   if (fd < 0) return -1;
   Conn c;
   c.fd = fd;
-  std::string req = "GET " + path + " HTTP/1.1\r\nHost: " + host + ":" +
-                    std::to_string(port) + "\r\nRange: bytes=" +
+  if (tls_ctx) {
+    SSL *ssl = SSL_new(tls_ctx);
+    if (!ssl) {
+      ::close(fd);
+      if (err) *err = "SSL_new failed";
+      return -1;
+    }
+    SSL_set_fd(ssl, fd);
+    const std::string &sni = host_header.empty() ? host : host_header;
+    SSL_set_tlsext_host_name(ssl, sni.c_str());
+    SSL_set1_host(ssl, sni.c_str());
+    ERR_clear_error();
+    if (SSL_connect(ssl) != 1) {
+      if (err) *err = "upstream TLS handshake failed: " + ssl_err_str();
+      SSL_free(ssl);
+      ::close(fd);
+      return -1;
+    }
+    c.ssl = ssl;
+  }
+  std::string hh = host_header.empty()
+                       ? host + ":" + std::to_string(port)
+                       : host_header;
+  std::string req = "GET " + path + " HTTP/1.1\r\nHost: " + hh +
+                    "\r\nRange: bytes=" +
                     std::to_string(a) + "-" + std::to_string(b - 1) +
-                    "\r\nConnection: close\r\n\r\n";
+                    "\r\nUser-Agent: demodel-tpu/0.1\r\n"
+                    "Connection: close\r\n\r\n";
   ResponseHead resp;
   if (!c.write_all(req.data(), req.size()) || !parse_response_head(&c, &resp)) {
-    ::close(fd);
-    if (err) *err = "peer slice request failed";
+    c.shutdown_close();
+    if (err) *err = "slice request failed";
     return -1;
   }
   // a 200 is acceptable only when the slice IS the whole object (origin
@@ -2095,13 +2120,13 @@ static int peer_fetch_slice(const std::string &host, int port,
     std::string cr = resp.headers.get("content-range");
     int64_t cr_start = cr.rfind("bytes ", 0) == 0 ? ::atoll(cr.c_str() + 6) : -1;
     if (cr_start != a) {
-      ::close(fd);
-      if (err) *err = "peer slice Content-Range mismatch";
+      c.shutdown_close();
+      if (err) *err = "slice Content-Range mismatch";
       return -1;
     }
   } else if (!(resp.status == 200 && a == 0 && b == total)) {
-    ::close(fd);
-    if (err) *err = "peer slice status " + std::to_string(resp.status);
+    c.shutdown_close();
+    if (err) *err = "slice status " + std::to_string(resp.status);
     return -1;
   }
   std::vector<char> bounce;
@@ -2112,18 +2137,18 @@ static int peer_fetch_slice(const std::string &host, int port,
         b - pos, direct ? (4 << 20) : (int64_t)bounce.size()));
     int n = c.read_some(direct ? direct + pos : bounce.data(), want);
     if (n <= 0) {
-      ::close(fd);
-      if (err) *err = "peer slice truncated";
+      c.shutdown_close();
+      if (err) *err = "slice truncated";
       return -1;
     }
     if (!direct && rw->pwrite_at(bounce.data(), n, pos) != 0) {
-      ::close(fd);
-      if (err) *err = "peer slice write failed";
+      c.shutdown_close();
+      if (err) *err = "slice write failed";
       return -1;
     }
     pos += n;
   }
-  ::close(fd);
+  c.shutdown_close();
   return 0;
 }
 
@@ -2131,7 +2156,8 @@ static int peer_fetch_slice(const std::string &host, int port,
 // threads. Returns 0, or -1 with the first slice error in *err.
 static int fetch_slices(const std::string &host, int port, const std::string &path,
                         int64_t total, int streams, char *direct, RangeWriter *rw,
-                        std::string *err) {
+                        std::string *err, SSL_CTX *tls_ctx = nullptr,
+                        const std::string &host_header = "") {
   std::vector<std::thread> threads;
   std::vector<std::string> errs(static_cast<size_t>(streams));
   std::vector<int> rcs(static_cast<size_t>(streams), 0);
@@ -2142,7 +2168,7 @@ static int fetch_slices(const std::string &host, int port, const std::string &pa
     threads.emplace_back([&, i, a, b] {
       rcs[static_cast<size_t>(i)] = peer_fetch_slice(
           host, port, path, a, b, total, direct, rw,
-          &errs[static_cast<size_t>(i)]);
+          &errs[static_cast<size_t>(i)], tls_ctx, host_header);
     });
   }
   for (auto &t : threads) t.join();
@@ -2217,6 +2243,64 @@ int64_t peer_fetch_parallel(Store *store, const std::string &host, int port,
   delete rw;
   if (rc == -EBADMSG) {
     if (err) *err = "peer digest mismatch (parallel): got " + std::string(digest);
+    return -1;
+  }
+  if (rc != 0) {
+    if (err) *err = "parallel commit failed: " + std::string(::strerror(-rc));
+    return -1;
+  }
+  return total;
+}
+
+
+// Upstream (HTTPS/CDN) parallel range fetch — the peer slice fan-out,
+// pointed at origin servers: verify-on by default (system roots + an
+// optional extra CA), SNI + hostname check per connection. The caller
+// resolves redirects and supplies the FINAL url parts + total size; any
+// failure returns -1 so Python degrades to its single-stream path.
+int64_t upstream_fetch_parallel(Store *store, const std::string &host,
+                                int port, bool tls, const std::string &ca,
+                                const std::string &path,
+                                const std::string &key, int64_t total,
+                                int streams,
+                                const std::string &expected_digest,
+                                const std::string &meta_json,
+                                std::string *err) {
+  const int64_t kMinSlice = 4ll << 20;
+  if (streams < 1) streams = 1;
+  if (total < 2 * kMinSlice) streams = 1;
+  streams = clamp_streams(streams, total);
+
+  SSL_CTX *ctx = nullptr;
+  if (tls) {
+    ctx = SSL_CTX_new(TLS_client_method());
+    if (!ctx) {
+      if (err) *err = "SSL_CTX_new failed";
+      return -1;
+    }
+    SSL_CTX_set_default_verify_paths(ctx);
+    if (!ca.empty()) SSL_CTX_load_verify_locations(ctx, ca.c_str(), nullptr);
+    SSL_CTX_set_verify(ctx, DM_SSL_VERIFY_PEER, nullptr);
+  }
+  RangeWriter *rw = store->begin_ranged(key, total, err);
+  if (!rw) {
+    if (ctx) SSL_CTX_free(ctx);
+    return -1;
+  }
+  int rc = fetch_slices(host, port, path, total, streams, nullptr, rw, err,
+                        ctx, host);
+  if (ctx) SSL_CTX_free(ctx);
+  if (rc != 0) {
+    rw->abort(false);
+    delete rw;
+    return -1;
+  }
+  char digest[65] = {0};
+  rc = rw->commit(meta_json, expected_digest, digest);
+  delete rw;
+  if (rc == -EBADMSG) {
+    if (err) *err = "upstream digest mismatch (parallel): got " +
+                    std::string(digest);
     return -1;
   }
   if (rc != 0) {
@@ -2336,6 +2420,28 @@ void dm_proxy_register_tensor(void *p, const char *model_tensor,
   loc.nbytes = nbytes;
   static_cast<dm::Proxy *>(p)->register_tensor(
       model_tensor ? model_tensor : "", std::move(loc));
+}
+
+
+int64_t dm_upstream_fetch_parallel(void *store, const char *host, int port,
+                                   int tls, const char *ca, const char *path,
+                                   const char *key, int64_t total, int streams,
+                                   const char *expected_digest,
+                                   const char *meta_json, char *errbuf,
+                                   int errlen) {
+  std::string err;
+  int64_t n = dm::upstream_fetch_parallel(
+      static_cast<dm::Store *>(store), host ? host : "", port, tls != 0,
+      ca ? ca : "", path ? path : "", key ? key : "", total, streams,
+      expected_digest ? expected_digest : "", meta_json ? meta_json : "{}",
+      &err);
+  if (n < 0 && errbuf && errlen > 0) {
+    int m = static_cast<int>(err.size());
+    if (m >= errlen) m = errlen - 1;
+    ::memcpy(errbuf, err.data(), static_cast<size_t>(m));
+    errbuf[m] = 0;
+  }
+  return n;
 }
 
 int dm_proxy_metrics(void *p, char *buf, int buflen) {

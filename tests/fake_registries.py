@@ -181,7 +181,8 @@ def make_hf_handler(repos: dict[str, dict[str, bytes]], commit: str = "c0ffee" *
                     })
                 else:
                     self._send(200, body, ctype="application/octet-stream",
-                               extra={"ETag": f'"{sha}"'})
+                               extra={"ETag": f'"{sha}"',
+                                      "Accept-Ranges": "bytes"})
                 return
 
             self._send(404, b'{"error":"not found"}')

@@ -255,3 +255,81 @@ def test_pull_dedups_against_mitm_cached_bytes(tmp_path):
             assert store.get(art.key) == body
         finally:
             store.close()
+
+
+# ---------------- round-3: upstream parallel range fetch (VERDICT #9)
+
+
+def test_upstream_parallel_range_fetch(tmp_path, monkeypatch):
+    """A large known-size upstream file fans out over N native TLS range
+    connections (the CDN leg of config-4 cold pulls): byte-exact, digest-
+    verified, and the origin sees multiple ranged CDN requests."""
+    import hashlib
+
+    monkeypatch.setenv("DEMODEL_UPSTREAM_PARALLEL_MIN_MB", "8")
+    monkeypatch.setenv("DEMODEL_UPSTREAM_STREAMS", "4")
+    rng = np.random.default_rng(42)
+    big = rng.integers(0, 255, 24 << 20, dtype=np.uint8).tobytes()
+    from demodel_tpu.formats import safetensors as stf
+
+    blob = stf.serialize({"w": np.frombuffer(big[: 16 << 20], np.uint8)})
+    repo = {"config.json": b'{"model_type": "llama"}',
+            "model.safetensors": blob}
+    handler = make_hf_handler({"org/big": repo})
+    with FakeUpstream(handler=handler, tls_dir=tmp_path / "ca") as up:
+        store = Store(tmp_path / "s")
+        try:
+            reg = HFRegistry(store, endpoint=f"https://{up.authority}",
+                             ca=str(up.ca_path))
+            report = reg.pull("org/big")
+            art = next(f for f in report.files
+                       if f.name == "model.safetensors")
+            assert store.get(art.key) == blob
+            assert store.meta(art.key)["sha256"] == \
+                hashlib.sha256(blob).hexdigest()
+            # the CDN actually served ranges in parallel slices
+            assert handler.request_counts.get("cdn", 0) >= 3
+        finally:
+            store.close()
+
+
+def test_upstream_parallel_falls_back_when_ranges_unsupported(tmp_path,
+                                                              monkeypatch):
+    """An origin that ignores Range degrades cleanly to the single-stream
+    path — same bytes, no error surfaced."""
+    import hashlib
+    from http.server import BaseHTTPRequestHandler
+
+    monkeypatch.setenv("DEMODEL_UPSTREAM_PARALLEL_MIN_MB", "1")
+    monkeypatch.setenv("DEMODEL_UPSTREAM_STREAMS", "4")
+    body = np.random.default_rng(7).bytes(12 << 20)
+
+    class NoRange(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_HEAD(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Accept-Ranges", "bytes")  # lies!
+            self.end_headers()
+
+        def do_GET(self):
+            self.send_response(200)  # ignores Range entirely
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    with FakeUpstream(handler=NoRange, tls_dir=tmp_path / "ca2") as up:
+        store = Store(tmp_path / "s2")
+        try:
+            from demodel_tpu.registry.base import Fetcher
+
+            f = Fetcher(store, ca=str(up.ca_path))
+            art = f.fetch(f"https://{up.authority}/blob.bin", name="blob.bin")
+            assert store.get(art.key) == body
+            assert art.sha256 == hashlib.sha256(body).hexdigest()
+        finally:
+            store.close()
