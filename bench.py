@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
@@ -254,14 +255,55 @@ def _check_regression(out: dict) -> dict:
     return out
 
 
+def _run_guarded(kind: str, timeout: int) -> dict | None:
+    """Run one bench leg in a subprocess with a hard timeout.
+
+    The TPU tunnel (axon) can wedge so that jax backend init blocks
+    forever inside ``make_c_api_client`` — uninterruptible from Python.
+    The driver must still get its ONE JSON line, so each leg runs in a
+    killable child."""
+    proc = subprocess.run(
+        [sys.executable, __file__, f"--{kind}-child"],
+        capture_output=True, text=True, timeout=timeout,
+    )
+    sys.stderr.write(proc.stderr[-4000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return parsed
+        except ValueError:
+            continue
+    return None
+
+
 def main():
-    try:
-        out = _bench_e2e()
-    except Exception as e:  # noqa: BLE001 — bench must always print a line
-        print(f"e2e bench failed ({type(e).__name__}: {e}); falling back",
-              file=sys.stderr)
-        out = _bench_fallback()
-    print(json.dumps(_check_regression(out)))
+    if "--e2e-child" in sys.argv:
+        print(json.dumps(_bench_e2e()))
+        return
+    if "--fallback-child" in sys.argv:
+        print(json.dumps(_bench_fallback()))
+        return
+    for kind, timeout in (("e2e", 900), ("fallback", 300)):
+        try:
+            out = _run_guarded(kind, timeout)
+            if out is not None:
+                print(json.dumps(_check_regression(out)))
+                return
+            print(f"{kind} bench produced no result; degrading",
+                  file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"{kind} bench timed out (wedged TPU tunnel?); degrading",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — bench must always print a line
+            print(f"{kind} bench failed ({type(e).__name__}: {e}); degrading",
+                  file=sys.stderr)
+    # truthful last resort: record that the device was unreachable rather
+    # than hanging the driver or faking a number
+    print(json.dumps({
+        "metric": "bench_unavailable_device_unreachable",
+        "value": 0.0, "unit": "MB/s/chip", "vs_baseline": 0.0,
+    }))
 
 
 if __name__ == "__main__":
