@@ -192,6 +192,27 @@ def _cmd_pull(cfg: ProxyConfig, args) -> int:
     return 0
 
 
+def _cmd_gc(cfg: ProxyConfig, args) -> int:
+    """One-shot store GC to the given (or env) cap — operability for
+    long-lived nodes without restarting the proxy."""
+    from demodel_tpu.delivery import open_store
+    from demodel_tpu.utils.env import env_int
+
+    max_gb = args.max_gb or env_int("DEMODEL_CACHE_MAX_GB", 0)
+    if max_gb <= 0:
+        print("gc: no cap given (--max-gb or DEMODEL_CACHE_MAX_GB)",
+              file=sys.stderr)
+        return 2
+    store = open_store(cfg)
+    try:
+        total, freed, evicted = store.gc(max_gb << 30)
+    finally:
+        store.close()
+    print(json.dumps({"cap_gb": max_gb, "in_use_bytes": total,
+                      "freed_bytes": freed, "evicted": evicted}))
+    return 0
+
+
 def _cmd_serve(cfg: ProxyConfig, args) -> int:
     """Run the full node: MITM caching proxy (with native /peer endpoints)
     plus the /restore API over the same store."""
@@ -250,6 +271,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="peer node base URL tried before upstream (repeatable)")
     sv = sub.add_parser("serve", help="run proxy + peer + restore APIs")
     sv.add_argument("--restore-port", type=int, default=8081)
+    g = sub.add_parser("gc", help="evict LRU cache entries to a size cap")
+    g.add_argument("--max-gb", type=int, default=0)
     return p
 
 
@@ -265,6 +288,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_pull(cfg, args)
     if cmd == "serve":
         return _cmd_serve(cfg, args)
+    if cmd == "gc":
+        return _cmd_gc(cfg, args)
     return _cmd_start(cfg, args)
 
 

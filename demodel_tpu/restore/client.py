@@ -10,6 +10,7 @@ bandwidth scales with hosts.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -18,6 +19,7 @@ from jax.sharding import Mesh
 
 from demodel_tpu.formats.safetensors import _np_dtype
 from demodel_tpu.parallel.mesh import make_mesh
+from demodel_tpu.utils.env import env_int
 from demodel_tpu.sink.hbm import Placement, place_tensor
 from demodel_tpu.sink.plan import ShardingPlan
 from demodel_tpu.utils.logging import get_logger
@@ -56,25 +58,52 @@ def restore(
 
     out = RestoreResult(mesh_desc=f"{dict(mesh.shape)}", manifest=manifest)
     fetched = 0
+    fetched_lock = threading.Lock()
     # bytes ride the native data plane when the node advertises one
     data_base = manifest.get("data_endpoint", endpoint).rstrip("/")
-    for name, info in manifest["tensors"].items():
+    tls = threading.local()
+
+    def _session():
+        sess = getattr(tls, "s", None)
+        if sess is None:
+            sess = tls.s = requests.Session()
+        return sess
+
+    def restore_one(item):
+        name, info = item
         shape = tuple(info["shape"])
         np_dtype = _np_dtype(info["dtype"])
         sharding = plan.sharding_for(name, shape, np_dtype.itemsize)
         url = f"{data_base}/restore/{model}/tensor/{name}"
 
-        def read_at(off, ln, url=url):
+        def read_at(off, ln):
             nonlocal fetched
-            rr = s.get(url, headers={"Range": f"bytes={off}-{off + ln - 1}"},
-                       timeout=timeout)
+            rr = _session().get(
+                url, headers={"Range": f"bytes={off}-{off + ln - 1}"},
+                timeout=timeout)
             rr.raise_for_status()
-            fetched += len(rr.content)
+            with fetched_lock:
+                fetched += len(rr.content)
             return rr.content
 
-        out.arrays[name] = place_tensor(
-            read_at, shape, np_dtype, 0, sharding, cast_to
-        )
+        return name, place_tensor(read_at, shape, np_dtype, 0, sharding,
+                                  cast_to)
+
+    # tensor-level fan-out: a restore is many independent range reads; a
+    # small pool hides HTTP latency (device_put is thread-safe)
+    items = list(manifest["tensors"].items())
+    workers = min(env_int("DEMODEL_RESTORE_WORKERS", 8, minimum=1),
+                  max(1, len(items)))
+    if workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            for name, arr in ex.map(restore_one, items):
+                out.arrays[name] = arr
+    else:
+        for item in items:
+            name, arr = restore_one(item)
+            out.arrays[name] = arr
     out.secs = time.perf_counter() - t0
     out.bytes_fetched = fetched
     log.info("restored %s: %d tensors, %.1f MB fetched in %.2fs",
