@@ -137,6 +137,19 @@ def pull_to_hbm(
         peer_set = PeerSet(peers)
     sink_worker = None
     handed_off = False  # True once the background finalizer owns flush+close
+    profile_dir = os.environ.get("DEMODEL_PROFILE_DIR", "").strip()
+    profiling = False
+    if profile_dir and deliver:
+        # SURVEY §5 tracing: a jax.profiler trace around the delivery
+        # window (fetch overlap + device_put stream) — open in xprof/
+        # tensorboard to see host→device transfer occupancy
+        try:
+            import jax.profiler as _profiler
+
+            _profiler.start_trace(profile_dir)
+            profiling = True
+        except Exception as e:  # noqa: BLE001 — tracing must never break a pull
+            log.warning("jax.profiler trace not started: %s", e)
     t0 = time.perf_counter()
     try:
         buffer_budget = None
@@ -252,6 +265,14 @@ def pull_to_hbm(
                     f"{reg.fetcher.integrity_failures}")
         return out, placed
     finally:
+        if profiling:
+            try:
+                import jax.profiler as _profiler
+
+                _profiler.stop_trace()
+                log.info("delivery trace written to %s", profile_dir)
+            except Exception as e:  # noqa: BLE001
+                log.warning("jax.profiler stop_trace failed: %s", e)
         if sink_worker is not None:  # pull raised — abandon delivery
             sink_worker.cancel()
         if not handed_off:

@@ -877,3 +877,64 @@ def test_native_restore_data_plane(pulled_node, mesh8, tmp_path):
                                             spec.start))
             np.testing.assert_array_equal(
                 np.asarray(result.arrays["layer.0.w"]), src)
+
+
+def test_byte_budget_admits_oversize_alone():
+    """A single buffer larger than the whole budget must pass (alone), not
+    deadlock — the 70B shard > budget case."""
+    import threading as th
+
+    from demodel_tpu.sink.streaming import ByteBudget
+
+    b = ByteBudget(100)
+    b.acquire(500)          # oversize admitted when budget is idle
+    blocked = th.Event()
+    passed = th.Event()
+
+    def second():
+        blocked.set()
+        b.acquire(10)       # must wait until the oversize releases
+        passed.set()
+
+    t = th.Thread(target=second, daemon=True)
+    t.start()
+    blocked.wait(2)
+    assert not passed.wait(0.3), "second acquire jumped the full budget"
+    b.release(500)
+    assert passed.wait(5), "release did not wake the waiter"
+    b.release(10)
+    assert b.in_use == 0
+
+
+def test_bench_regression_gate(tmp_path, monkeypatch):
+    """bench.py flags a >10% drop against the newest BENCH_r*.json."""
+    import json as _json
+
+    import bench as bench_mod
+
+    monkeypatch.setattr(bench_mod, "REPO", tmp_path)
+    (tmp_path / "BENCH_r07.json").write_text(_json.dumps(
+        {"parsed": {"metric": "cold_pull_to_hbm_throughput", "value": 200.0,
+                    "unit": "MB/s/chip"}}))
+    out = bench_mod._check_regression(
+        {"metric": "cold_pull_to_hbm_throughput", "value": 100.0,
+         "unit": "MB/s/chip", "vs_baseline": 1.0})
+    assert out["regressed"] is True and out["vs_prev"] == 0.5
+    ok = bench_mod._check_regression(
+        {"metric": "cold_pull_to_hbm_throughput", "value": 250.0,
+         "unit": "MB/s/chip", "vs_baseline": 1.0})
+    assert "regressed" not in ok and ok["vs_prev"] == 1.25
+
+
+def test_delivery_profile_trace(tmp_path, mesh8, monkeypatch):
+    """DEMODEL_PROFILE_DIR captures a jax.profiler trace around delivery."""
+    handler = make_hf_handler({"org/prof": build_hf_repo(n_shards=1)})
+    with FakeUpstream(handler=handler) as up:
+        monkeypatch.setenv("DEMODEL_PROFILE_DIR", str(tmp_path / "trace"))
+        cfg = ProxyConfig(cache_dir=tmp_path / "cache",
+                          data_dir=tmp_path / "data")
+        report, placed = delivery.pull_to_hbm(
+            "org/prof", cfg, endpoint=f"http://{up.authority}", mesh=mesh8)
+        assert placed is not None
+    produced = list((tmp_path / "trace").rglob("*"))
+    assert any(p.is_file() for p in produced), "no trace files written"
