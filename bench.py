@@ -234,22 +234,37 @@ def _bench_fallback() -> dict:
 
 
 def _check_regression(out: dict) -> dict:
-    """Perf regression gate (VERDICT r2 #1): compare against the newest
-    recorded round. A drop >10% is flagged loudly on stderr and in the
+    """Perf regression gate (VERDICT r2 #1, anchor fixed per VERDICT r3 #2):
+    compare against the most recent recorded round whose metric MATCHES —
+    skipping outage/fallback rounds (e.g. ``bench_unavailable_*``), which
+    previously lost the anchor and shipped r04 with no ``vs_prev`` at all.
+    Also reports ``vs_best`` against the best matching round ever recorded.
+    A drop >10% vs either anchor is flagged loudly on stderr and in the
     JSON — a regressed number must never ship silently again."""
     try:
-        prev_files = sorted(REPO.glob("BENCH_r*.json"))
-        if not prev_files:
+        anchors = []  # (filename, value), oldest → newest, matching metric only
+        for pf in sorted(REPO.glob("BENCH_r*.json")):
+            try:
+                prev = json.loads(pf.read_text()).get("parsed", {})
+            except ValueError:
+                continue
+            if prev.get("metric") == out["metric"] and prev.get("value", 0) > 0:
+                anchors.append((pf.name, float(prev["value"])))
+        if not anchors:
             return out
-        prev = json.loads(prev_files[-1].read_text()).get("parsed", {})
-        if prev.get("metric") != out["metric"]:
-            return out
-        out["vs_prev"] = round(out["value"] / prev["value"], 3)
-        if out["value"] < 0.9 * prev["value"]:
+        prev_name, prev_val = anchors[-1]
+        best_name, best_val = max(anchors, key=lambda a: a[1])
+        out["vs_prev"] = round(out["value"] / prev_val, 3)
+        out["vs_best"] = round(out["value"] / best_val, 3)
+        if out["value"] < 0.9 * prev_val:
             out["regressed"] = True
             print(f"PERF REGRESSION: {out['value']} {out['unit']} < "
-                  f"last round's {prev['value']} ({prev_files[-1].name})",
+                  f"last matching round's {prev_val} ({prev_name})",
                   file=sys.stderr)
+        elif out["value"] < 0.9 * best_val:
+            out["regressed_vs_best"] = True
+            print(f"PERF below best-ever: {out['value']} {out['unit']} < "
+                  f"{best_val} ({best_name})", file=sys.stderr)
     except Exception as e:  # noqa: BLE001 — the gate must not kill the bench
         print(f"regression check skipped: {e}", file=sys.stderr)
     return out

@@ -75,6 +75,8 @@ def _configure(L: ctypes.CDLL) -> None:
     sig("dm_store_list", I, [P, CP, I])
     sig("dm_store_gc", I64, [P, I64, c.POINTER(I64), c.POINTER(I)])
     sig("dm_store_evictions", I64, [P])
+    sig("dm_store_pin", None, [P, CP])
+    sig("dm_store_unpin", None, [P, CP])
     sig("dm_key_for_uri", None, [CP, CP])
     # streaming writer
     sig("dm_writer_append", I, [P, P, I64])
@@ -92,6 +94,7 @@ def _configure(L: ctypes.CDLL) -> None:
     sig("dm_peer_fetch_parallel", I64,
         [P, CP, I, CP, CP, I64, I, CP, CP, CP, I])
     sig("dm_peer_fetch_into", I64, [CP, I, CP, I64, I, CP, P, CP, I])
+    sig("dm_peer_fetch_window", I64, [CP, I, CP, I64, I64, I64, I, P, CP, I])
     sig("dm_upstream_fetch_parallel", I64,
         [P, CP, I, I, CP, CP, CP, I64, I, CP, CP, CP, I])
     # proxy prototypes are configured in demodel_tpu.proxy (its call sites)

@@ -81,6 +81,7 @@ class ProxyServer:
             in ("0", "false", "no", "off") else 1,
             env_int("DEMODEL_FILL_MAX_MB", 512),
             env_int("DEMODEL_FILL_MIN_PCT", 5),
+            env_int("DEMODEL_CHALLENGE_TTL_S", 86400),
         )
         if not self._h:
             raise OSError("proxy allocation failed")
@@ -93,7 +94,7 @@ class ProxyServer:
         L.dm_proxy_new.argtypes = [
             c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_char_p,
             c.c_char_p, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_int64,
-            c.c_int64, c.c_int, c.c_int64, c.c_int,
+            c.c_int64, c.c_int, c.c_int64, c.c_int, c.c_int,
         ]
         L.dm_proxy_new.restype = c.c_void_p
         L.dm_proxy_start.argtypes = [c.c_void_p]

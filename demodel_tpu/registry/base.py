@@ -323,6 +323,7 @@ class Fetcher:
                             minimum=1) << 20
         if streams <= 1 or self._proxies or extra_headers:
             return None
+        session_auth = "Authorization" in self.session.headers
         try:
             h = self.session.head(url, timeout=30, allow_redirects=True,
                                   verify=self.verify)
@@ -334,6 +335,15 @@ class Fetcher:
             return None
         parts = urlsplit(h.url)
         if parts.scheme not in ("http", "https") or not parts.hostname:
+            return None
+        if session_auth and not (h.url != url and parts.query):
+            # ADVICE r3 low: session-level Authorization (gated-repo HF
+            # token) never enters the native path — it forwards no auth.
+            # Proceed only when the HEAD redirected to a signed URL
+            # (query-string credentials); a same-auth origin URL would
+            # just 401 across N wasted TLS connects. NB a presigned URL
+            # can still be bound to the HEAD method — the native fetch
+            # degrades to single-stream on the first non-206 in that case.
             return None
         port = parts.port or (443 if parts.scheme == "https" else 80)
         path = parts.path or "/"

@@ -246,6 +246,15 @@ class Store:
     def evictions_total(self) -> int:
         return self._lib.dm_store_evictions(self._h)
 
+    def pin(self, key: str) -> None:
+        """Shield ``key`` from :meth:`gc` eviction (process-local). The
+        restore registry pins every blob it advertises — evicting one
+        mid-serve would 404 the restore data plane (ADVICE r3 medium)."""
+        self._lib.dm_store_pin(self._h, key.encode())
+
+    def unpin(self, key: str) -> None:
+        self._lib.dm_store_unpin(self._h, key.encode())
+
     def materialize(self, key: str, digest: str, meta: dict) -> None:
         """Publish already-stored bytes (located by content digest) under a
         new key via hardlink — content-address dedup, zero copy."""

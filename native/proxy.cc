@@ -8,6 +8,7 @@
 #include <poll.h>
 #include <sys/sendfile.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -705,7 +706,8 @@ class Session {
       key = auth.empty() ? key_for_uri(uri)
                          : key_for_uri(uri + "\nauth=" + auth_scope);
 
-    if (cacheable && p_->store_->has(key) && !stale_redirect(key)) {
+    if (cacheable && p_->store_->has(key) && !stale_redirect(key) &&
+        !stale_challenge(key)) {
       p_->metrics_.cache_hits++;
       return serve_from_cache(req, uri, key);
     }
@@ -773,6 +775,13 @@ class Session {
     }
 
     if (!ensure_upstream(authority, host, port, tls)) {
+      if (cacheable && p_->store_->has(key)) {
+        // stale-if-error: a TTL-expired challenge (or any cached copy)
+        // beats a 502 while the registry is unreachable — revalidation
+        // only replaces the entry when upstream actually answers
+        p_->metrics_.cache_hits++;
+        return serve_from_cache(req, uri, key);
+      }
       p_->metrics_.errors++;
       send_simple(&client_, 502, "Bad Gateway", "upstream connect failed");
       return false;
@@ -821,6 +830,26 @@ class Session {
     if (p_->store_->has_digest(linked)) return false;
     p_->store_->remove(key);
     return true;
+  }
+
+  // A cached anonymous 401 challenge older than the TTL should revalidate
+  // against the live registry (token realm/service can change — ADVICE r3
+  // low). The entry is NOT dropped here: when upstream is unreachable the
+  // miss path falls back to serving it stale (offline-first).
+  bool stale_challenge(const std::string &key) {
+    if (p_->cfg_.challenge_ttl_sec <= 0) return false;
+    // keep the meta read off the warm blob-serving path: challenge bodies
+    // are tiny JSON errors — a multi-MB object cannot be one (same
+    // single-stat gating idea as stale_redirect above)
+    int64_t sz = p_->store_->size(key);
+    if (sz < 0 || sz > (64 << 10)) return false;
+    std::string meta = p_->store_->meta(key);
+    auto pos = meta.find("\"status\":");
+    if (pos == std::string::npos) return false;
+    if (::atoll(meta.c_str() + pos + 9) != 401) return false;
+    struct stat st;
+    if (::stat(p_->store_->obj_path(key).c_str(), &st) != 0) return false;
+    return ::time(nullptr) - st.st_mtime > p_->cfg_.challenge_ttl_sec;
   }
 
   // Parse a single-range "bytes=a-b" / "bytes=a-" / "bytes=-n" spec.
@@ -1836,7 +1865,14 @@ SSL_CTX *Proxy::leaf_ctx(const std::string &host, std::string *err) {
 }
 
 void Proxy::register_tensor(const std::string &model_tensor, TensorLoc loc) {
+  // Pin the backing blob: size-cap GC on the serving loop must never evict
+  // an object the restore data plane is advertising (ADVICE r3 medium —
+  // eviction would 404 or drop connections mid-restore).
+  if (store_) store_->pin(loc.key);
   std::lock_guard<std::mutex> g(restore_mu_);
+  auto it = restore_map_.find(model_tensor);
+  if (it != restore_map_.end() && store_)
+    store_->unpin(it->second.key);  // replaced registration frees its pin
   restore_map_[model_tensor] = std::move(loc);
 }
 
@@ -2075,7 +2111,8 @@ static int peer_fetch_slice(const std::string &host, int port,
                             const std::string &path, int64_t a, int64_t b,
                             int64_t total, char *direct, RangeWriter *rw,
                             std::string *err, SSL_CTX *tls_ctx = nullptr,
-                            const std::string &host_header = "") {
+                            const std::string &host_header = "",
+                            int64_t direct_bias = 0) {
   int fd = tcp_connect(host, port, 30, err);
   if (fd < 0) return -1;
   Conn c;
@@ -2135,7 +2172,8 @@ static int peer_fetch_slice(const std::string &host, int port,
   while (pos < b) {
     int want = static_cast<int>(std::min<int64_t>(
         b - pos, direct ? (4 << 20) : (int64_t)bounce.size()));
-    int n = c.read_some(direct ? direct + pos : bounce.data(), want);
+    int n = c.read_some(direct ? direct + (pos - direct_bias) : bounce.data(),
+                        want);
     if (n <= 0) {
       c.shutdown_close();
       if (err) *err = "slice truncated";
@@ -2213,6 +2251,44 @@ int64_t peer_fetch_into(const std::string &host, int port,
     }
   }
   return total;
+}
+
+// Parallel range fetch of one WINDOW [obj_off, obj_off+length) of a remote
+// object straight into caller memory — the shard-read primitive: a pod
+// host places only its devices' byte ranges, so only those bytes cross
+// DCN (SURVEY.md §2.3 "peer shard cache"; the sharded delivery path hands
+// per-tensor/per-device windows here and device_put's the buffer).
+int64_t peer_fetch_window(const std::string &host, int port,
+                          const std::string &path, int64_t obj_off,
+                          int64_t length, int64_t obj_total, int streams,
+                          char *out, std::string *err) {
+  if (length <= 0 || obj_off < 0 || obj_off + length > obj_total) {
+    if (err) *err = "bad window";
+    return -1;
+  }
+  streams = clamp_streams(streams, length);
+  std::vector<std::thread> threads;
+  std::vector<std::string> errs(static_cast<size_t>(streams));
+  std::vector<int> rcs(static_cast<size_t>(streams), 0);
+  int64_t per = (length + streams - 1) / streams;
+  for (int i = 0; i < streams; i++) {
+    int64_t a = obj_off + i * per;
+    int64_t b = std::min<int64_t>(obj_off + length, a + per);
+    if (a >= b) continue;
+    threads.emplace_back([&, i, a, b] {
+      rcs[static_cast<size_t>(i)] = peer_fetch_slice(
+          host, port, path, a, b, obj_total, out, nullptr,
+          &errs[static_cast<size_t>(i)], nullptr, "", /*direct_bias=*/obj_off);
+    });
+  }
+  for (auto &t : threads) t.join();
+  for (int i = 0; i < streams; i++) {
+    if (rcs[static_cast<size_t>(i)] != 0) {
+      if (err) *err = errs[static_cast<size_t>(i)];
+      return -1;
+    }
+  }
+  return length;
 }
 
 int64_t peer_fetch_parallel(Store *store, const std::string &host, int port,
@@ -2321,7 +2397,8 @@ void *dm_proxy_new(const char *host, int port, int mitm_all, int no_mitm,
                    const char *upstream_ca, int cache_enabled, void *mint_cb,
                    int verbose, int io_timeout_sec, int64_t max_body_mb,
                    int64_t cache_max_mb, int ranged_fill,
-                   int64_t fill_max_mb, int fill_min_pct) {
+                   int64_t fill_max_mb, int fill_min_pct,
+                   int challenge_ttl_sec) {
   dm::ProxyConfig cfg;
   cfg.host = host ? host : "127.0.0.1";
   cfg.port = port;
@@ -2349,6 +2426,7 @@ void *dm_proxy_new(const char *host, int port, int mitm_all, int no_mitm,
   cfg.ranged_fill = ranged_fill != 0;
   if (fill_max_mb >= 0) cfg.fill_max_bytes = fill_max_mb << 20;
   if (fill_min_pct >= 0) cfg.fill_min_cover_pct = fill_min_pct;
+  if (challenge_ttl_sec >= 0) cfg.challenge_ttl_sec = challenge_ttl_sec;
   return new dm::Proxy(std::move(cfg));
 }
 
@@ -2402,6 +2480,23 @@ int64_t dm_peer_fetch_into(const char *host, int port, const char *path,
                                   total, streams,
                                   expected_digest ? expected_digest : "",
                                   static_cast<char *>(out), &err);
+  if (n < 0 && errbuf && errlen > 0) {
+    int m = static_cast<int>(err.size());
+    if (m >= errlen) m = errlen - 1;
+    ::memcpy(errbuf, err.data(), static_cast<size_t>(m));
+    errbuf[m] = 0;
+  }
+  return n;
+}
+
+int64_t dm_peer_fetch_window(const char *host, int port, const char *path,
+                             int64_t obj_off, int64_t length,
+                             int64_t obj_total, int streams, void *out,
+                             char *errbuf, int errlen) {
+  std::string err;
+  int64_t n = dm::peer_fetch_window(host ? host : "", port, path ? path : "",
+                                    obj_off, length, obj_total, streams,
+                                    static_cast<char *>(out), &err);
   if (n < 0 && errbuf && errlen > 0) {
     int m = static_cast<int>(err.size());
     if (m >= errlen) m = errlen - 1;

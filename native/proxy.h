@@ -52,6 +52,10 @@ struct ProxyConfig {
   bool ranged_fill = true;
   int64_t fill_max_bytes = 512ll << 20;  // size-based fill ceiling (0=off)
   int fill_min_cover_pct = 5;            // %-coverage that justifies a fill
+  // cached anonymous 401 registry challenges revalidate upstream after
+  // this long; while upstream is unreachable the stale copy still replays
+  // (offline-first). 0 = never expire (ADVICE r3 low).
+  int challenge_ttl_sec = 86400;
 };
 
 struct Metrics {

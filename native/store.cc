@@ -339,13 +339,27 @@ int Store::open_read_fd(const std::string &key) {
         ::stat(obj_path(key).c_str(), &ondisk) == 0 &&
         cached.st_ino == ondisk.st_ino) {
       int dup_fd = ::fcntl(it->second, F_DUPFD_CLOEXEC, 0);
-      if (dup_fd >= 0) return dup_fd;
+      if (dup_fd >= 0) {
+        struct timespec times[2];
+        times[0].tv_nsec = UTIME_NOW;   // see fresh-open comment below
+        times[1].tv_nsec = UTIME_OMIT;
+        ::futimens(dup_fd, times);
+        return dup_fd;
+      }
     }
     ::close(it->second);
     fd_cache_.erase(it);
   }
   int fd = ::open(obj_path(key).c_str(), O_RDONLY | O_CLOEXEC);
   if (fd < 0) return -1;
+  // Explicit atime bump: GC recency must reflect reads, but relatime
+  // mounts refresh atime at most daily — an actively-served object would
+  // otherwise look cold and get evicted before idle ones (ADVICE r3).
+  // Only on fresh opens; cached-fd hits inherit the bump from the miss.
+  struct timespec times[2];
+  times[0].tv_nsec = UTIME_NOW;   // atime ← now
+  times[1].tv_nsec = UTIME_OMIT;  // mtime untouched (commit time)
+  ::futimens(fd, times);
   if (fd_cache_.size() >= 64) {  // small bound; eviction order is arbitrary
     auto victim = fd_cache_.begin();
     ::close(victim->second);
@@ -659,6 +673,10 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
       std::lock_guard<std::mutex> g(writers_mu_);
       if (active_writers_.count(en.key)) continue;  // never an active key
     }
+    {
+      std::lock_guard<std::mutex> g(pin_mu_);
+      if (pinned_.count(en.key)) continue;  // restore-registered: serving
+    }
     std::string old_meta = meta(en.key);
     if (!old_meta.empty()) drop_digest_ref(en.key, old_meta);
     if (::unlink(obj_path(en.key).c_str()) != 0 && errno != ENOENT) continue;
@@ -682,6 +700,17 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
   }
   invalidate_index();
   return total;
+}
+
+void Store::pin(const std::string &key) {
+  std::lock_guard<std::mutex> g(pin_mu_);
+  pinned_[key]++;
+}
+
+void Store::unpin(const std::string &key) {
+  std::lock_guard<std::mutex> g(pin_mu_);
+  auto it = pinned_.find(key);
+  if (it != pinned_.end() && --it->second <= 0) pinned_.erase(it);
 }
 
 std::string Store::list_keys() {
@@ -874,6 +903,14 @@ int64_t dm_store_gc(void *h, int64_t max_bytes, int64_t *freed_bytes,
                     int *evicted_count) {
   return static_cast<dm::Store *>(h)->gc(max_bytes, freed_bytes,
                                          evicted_count);
+}
+
+void dm_store_pin(void *h, const char *key) {
+  static_cast<dm::Store *>(h)->pin(key);
+}
+
+void dm_store_unpin(void *h, const char *key) {
+  static_cast<dm::Store *>(h)->unpin(key);
 }
 
 int64_t dm_store_evictions(void *h) {

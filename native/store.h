@@ -138,6 +138,14 @@ class Store {
   // resulting total byte count; out-params report freed bytes / count.
   int64_t gc(int64_t max_bytes, int64_t *freed_bytes, int *evicted_count);
   int64_t evictions_total() const { return evictions_total_; }
+  // Pin a key against GC eviction (restore-registered blobs: evicting one
+  // mid-serve would 404 the native restore data plane). Pins are process-
+  // local, like the restore map they protect, and REFCOUNTED: a blob
+  // shared by several registrations stays pinned until every one of them
+  // unpins (re-registering a model must release the replaced checkpoint
+  // back to GC, not leak it out of the cap's reach forever).
+  void pin(const std::string &key);
+  void unpin(const std::string &key);
 
   // -- paths (used by writers and the proxy's fill-attach reader)
   std::string obj_path(const std::string &key) const;
@@ -167,6 +175,8 @@ class Store {
 
   std::mutex fd_mu_;
   std::unordered_map<std::string, int> fd_cache_;  // key → open O_RDONLY fd
+  std::mutex pin_mu_;
+  std::map<std::string, int> pinned_;  // key → pin refcount (GC skips >0)
 
   std::mutex index_mu_;
   std::string index_cache_;

@@ -89,6 +89,81 @@ def test_gc_counts_hardlinked_bytes_once(store):
     assert store.has("gcshared00000001") and store.has("gcshared00000002")
 
 
+def test_gc_spares_pinned_keys(store):
+    """ADVICE r3 medium: blobs the restore plane advertises are pinned —
+    GC under pressure must route around them, however cold they look."""
+    keys = _fill(store, 6)
+    store.pin(keys[0])  # the oldest = first LRU victim without the pin
+    total, freed, evicted = store.gc(1)
+    assert evicted >= 4
+    assert store.has(keys[0]), "pinned key was evicted"
+    store.unpin(keys[0])
+    store.gc(1)
+    assert not store.has(keys[0])  # unpin restores evictability
+
+
+def test_read_bumps_gc_recency(store):
+    """ADVICE r3 low: serving a key must refresh its LRU recency even on
+    relatime/noatime mounts (explicit futimens on read, not fs atime)."""
+    keys = _fill(store, 4)
+    time.sleep(0.02)
+    store.pread(keys[0], 10, 0)  # oldest key, freshly served
+    # evict exactly the coldest entries: the served key must outlive
+    # the younger-but-idle keys[1]
+    total, freed, evicted = store.gc(250_000)
+    assert evicted >= 1
+    assert store.has(keys[0]), "served key evicted despite fresh read"
+    assert not store.has(keys[1])
+
+
+def test_restore_registration_pins_backing_blob(tmp_path):
+    """The registry pin: register a model, then squeeze the cache — the
+    registered blob survives and the data plane keeps serving."""
+    from demodel_tpu.formats import safetensors as st
+    from demodel_tpu.restore.server import RestoreRegistry
+
+    s = Store(tmp_path / "store")
+    try:
+        tensors = {"w": np.arange(64, dtype=np.float32).reshape(8, 8)}
+        blob = st.serialize(tensors)
+        s.put("restoreblob00001", blob, {"size": len(blob)})
+        # bulk so the cap bites
+        for i in range(5):
+            s.put(f"bulk{i:012d}", np.random.default_rng(i).bytes(100_000), {})
+            time.sleep(0.01)
+        reg = RestoreRegistry(s)
+        reg.register_safetensors("org/pin", ["restoreblob00001"])
+        total, freed, evicted = s.gc(1)
+        assert evicted >= 5
+        assert s.has("restoreblob00001")
+        assert reg.locate("org/pin", "w") is not None
+    finally:
+        s.close()
+
+
+def test_reregistration_releases_replaced_pin(tmp_path):
+    """Pins are refcounted and re-registering a model unpins the replaced
+    checkpoint — a model update must not leak blobs out of GC's reach."""
+    from demodel_tpu.formats import safetensors as st
+    from demodel_tpu.restore.server import RestoreRegistry
+
+    s = Store(tmp_path / "store")
+    try:
+        old = st.serialize({"w": np.zeros((64, 64), np.float32)})
+        new = st.serialize({"w": np.ones((64, 64), np.float32)})
+        s.put("ckptold00000001", old, {})
+        time.sleep(0.01)
+        s.put("ckptnew00000001", new, {})
+        reg = RestoreRegistry(s)
+        reg.register_safetensors("org/up", ["ckptold00000001"])
+        reg.register_safetensors("org/up", ["ckptnew00000001"])  # update
+        total, freed, evicted = s.gc(1)
+        assert not s.has("ckptold00000001"), "replaced checkpoint stayed pinned"
+        assert s.has("ckptnew00000001")
+    finally:
+        s.close()
+
+
 def test_proxy_enforces_cache_cap(tmp_path, monkeypatch):
     """DEMODEL_CACHE_MAX_GB bounds the MITM cache: after many distinct
     pulls the store stays near the cap and evicted keys re-fetch."""

@@ -1,0 +1,82 @@
+"""Dated TPU-tunnel probe (VERDICT r3 #1 outage fallback).
+
+Appends one JSON line per run to TUNNEL_LOG.jsonl: timestamp, whether the
+axon-tunnelled chip answered within the deadline, backend-init time, and a
+small+large `device_put` throughput sample. Run it in a killable child —
+the known failure mode is an uninterruptible hang inside
+``make_c_api_client`` (PROFILE_r03.md), so the parent enforces the timeout.
+
+Usage: python tools/tunnel_probe.py [--timeout 240]
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+LOG = REPO / "TUNNEL_LOG.jsonl"
+
+
+def _child() -> None:
+    t0 = time.time()
+    import jax
+    import numpy as np
+
+    d = jax.devices()[0]
+    init_s = round(time.time() - t0, 1)
+    x = np.zeros(1 << 18, np.float32)  # 1 MB
+    t = time.time()
+    jax.block_until_ready(jax.device_put(x, d))
+    small_s = round(time.time() - t, 2)
+    big = np.zeros(16 << 20 >> 2, np.float32)  # 16 MB
+    t = time.time()
+    jax.block_until_ready(jax.device_put(big, d))
+    big_dt = time.time() - t
+    print(json.dumps({
+        "ok": True, "device": str(d), "init_s": init_s,
+        "put_1mb_s": small_s,
+        "put_16mb_mbps": round(16 / big_dt, 1),
+    }))
+
+
+def main() -> None:
+    if "--child" in sys.argv:
+        _child()
+        return
+    timeout = 240
+    if "--timeout" in sys.argv:
+        timeout = int(sys.argv[sys.argv.index("--timeout") + 1])
+    stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    rec: dict = {"ts": stamp, "timeout_s": timeout}
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--child"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        parsed = None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if parsed:
+            rec.update(parsed)
+        else:
+            rec.update({"ok": False,
+                        "error": (proc.stderr or "no output")[-400:]})
+    except subprocess.TimeoutExpired:
+        rec.update({"ok": False, "error": f"wedged: no response in {timeout}s"})
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
