@@ -194,7 +194,12 @@ class StreamingSink:
                     # ici_complete=False: delivery order here follows fetch
                     # completion, which is NOT synchronized across hosts —
                     # a cross-host collective from this thread would pair
-                    # with a different tensor's collective on another host
+                    # with a different tensor's collective on another host.
+                    # Multi-host pulls that want the ICI leg use the
+                    # manifest-ordered sharded pod path instead
+                    # (demodel_tpu.sink.remote.pull_manifest_to_hbm), where
+                    # per-host reads are window-sized from the start and
+                    # collective order is deterministic by construction.
                     placed = deliver_file(self.store, name, key, self.mesh,
                                           self.plan, self.cast_to,
                                           buffer=buffer, ici_complete=False)

@@ -1,0 +1,64 @@
+"""Worker for the composed pod-delivery proof (VERDICT r3 #3).
+
+Each of two OS processes owns 4 virtual CPU devices of one 8-device
+``jax.distributed`` mesh. NEITHER has a store, a cache directory, or any
+filesystem path to the checkpoint: the ONLY byte source is the warm
+peer's HTTP plane (``/peer/*`` on the native proxy). Both run the
+sharded pod pull (`demodel_tpu.sink.remote.pull_manifest_to_hbm`) —
+manifest discovery, per-device window reads over "DCN", ICI completion
+for replicated tensors — and report per-host NETWORK bytes, which the
+test asserts are a strict fraction of the checkpoint.
+
+Prints one JSON line:
+{"pid": N, "network_bytes": N, "weight_bytes": N, "fp": {...},
+ "rep_local_sum": F, "rep_shape": [...]}
+"""
+
+import json
+import os
+import sys
+
+pid = int(sys.argv[1])
+coord_port = sys.argv[2]
+peer_url = sys.argv[3]
+model = sys.argv[4]
+mode = sys.argv[5]  # "tp" shards matrices | "dp" replicates everything
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(f"localhost:{coord_port}", num_processes=2,
+                           process_id=pid)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from demodel_tpu.parallel.collectives import fingerprint  # noqa: E402
+from demodel_tpu.parallel.mesh import make_mesh  # noqa: E402
+from demodel_tpu.sink.remote import pull_manifest_to_hbm  # noqa: E402
+
+assert jax.device_count() == 8 and len(jax.local_devices()) == 4
+
+mesh = make_mesh(8) if mode == "tp" else make_mesh(8, tp=1)
+
+report, placed = pull_manifest_to_hbm(
+    model, [peer_url], mesh=mesh, ici_complete=True)
+
+fps = {name: [float(x) for x in np.asarray(fingerprint(a))]
+       for name, a in sorted(placed.arrays.items())}
+
+rep = placed.arrays["replicated.big"]
+local = np.asarray(rep.addressable_shards[0].data)
+
+print(json.dumps({
+    "pid": pid,
+    "network_bytes": report["network_bytes"],
+    "weight_bytes": report["weight_bytes"],
+    "fp": fps,
+    "rep_local_sum": float(local.astype(np.float64).sum()),
+    "rep_shape": list(rep.shape),
+}), flush=True)

@@ -1,0 +1,153 @@
+"""Composed pod-delivery proof (VERDICT r3 #3/#4).
+
+The round-3 two-host proof read a shared filesystem store; here the two
+``jax.distributed`` processes have NO filesystem access to the checkpoint
+at all — every byte arrives over the warm peer's HTTP plane (the "DCN"
+leg), sharded reads only, and replicated tensors complete over the mesh
+all-gather (the "ICI" leg). The test FAILS if either host fetches the
+full checkpoint over HTTP.
+
+Ref: /root/reference/README.md:5-10 ("run the proxy near your friends");
+SURVEY.md §2.3 (peer shard cache, intra-pod shard exchange).
+"""
+
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from demodel_tpu import delivery
+from demodel_tpu.config import ProxyConfig
+from demodel_tpu.formats import safetensors as st
+from demodel_tpu.proxy import ProxyServer
+
+from .fake_registries import make_hf_handler
+from .servers import FakeUpstream
+
+MODEL = "org/pod"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build_pod_repo() -> tuple[dict, dict]:
+    """2-shard repo: tp-shardable matrices + one big replicated tensor
+    (the ICI-completion target). Returns (files, tensors)."""
+    rng = np.random.default_rng(7)
+    tensors = {
+        "blocks.0.w": rng.standard_normal((256, 128)).astype(np.float32),
+        "blocks.0.b": rng.standard_normal((64,)).astype(np.float32),
+        "blocks.1.w": rng.standard_normal((256, 128)).astype(np.float32),
+        "replicated.big": rng.standard_normal((512, 64)).astype(np.float32),
+    }
+    shard1 = {k: tensors[k] for k in ("blocks.0.w", "blocks.0.b")}
+    shard2 = {k: tensors[k] for k in ("blocks.1.w", "replicated.big")}
+    files = {
+        "config.json": json.dumps({"model_type": "llama"}).encode(),
+        "model-00001-of-00002.safetensors": st.serialize(shard1),
+        "model-00002-of-00002.safetensors": st.serialize(shard2),
+    }
+    files["model.safetensors.index.json"] = json.dumps({
+        "metadata": {},
+        "weight_map": {k: ("model-00001-of-00002.safetensors" if k in shard1
+                           else "model-00002-of-00002.safetensors")
+                       for k in tensors},
+    }).encode()
+    return files, tensors
+
+
+@pytest.fixture()
+def warm_peer(tmp_path):
+    """A warm node: model pulled into its store, native proxy serving
+    /peer/* over it. Yields (peer_url, tensors, weight_nbytes)."""
+    files, tensors = _build_pod_repo()
+    handler = make_hf_handler({MODEL: files})
+    with FakeUpstream(handler=handler) as up:
+        cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                          cache_dir=tmp_path / "warm-cache",
+                          data_dir=tmp_path / "warm-data", use_ecdsa=True)
+        delivery.pull(MODEL, cfg, endpoint=f"http://{up.authority}")
+        weight_nbytes = sum(a.nbytes for a in tensors.values())
+        with ProxyServer(cfg, verbose=False) as peer:
+            yield peer.url, tensors, weight_nbytes
+
+
+def test_single_process_wire_parity(warm_peer, mesh8):
+    """Correctness first: the over-the-wire sharded placement is byte-
+    exact vs the source tensors (single process, 8 devices)."""
+    peer_url, tensors, weight_nbytes = warm_peer
+    from demodel_tpu.sink.remote import pull_manifest_to_hbm
+
+    report, placed = pull_manifest_to_hbm(MODEL, [peer_url], mesh=mesh8)
+    assert set(placed.arrays) == set(tensors)
+    for name, want in tensors.items():
+        np.testing.assert_array_equal(np.asarray(placed.arrays[name]), want)
+    # a single host must fetch every weight byte (plus header slack), once
+    assert report["network_bytes"] >= weight_nbytes
+    assert report["network_bytes"] <= weight_nbytes * 1.1 + 65536
+
+
+def _run_workers(peer_url, mode):
+    import os
+
+    port = _free_port()
+    worker = Path(__file__).parent / "pod_pull_worker.py"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i), str(port), peer_url, MODEL,
+         mode],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    return outs
+
+
+def test_pod_pull_splits_network_bytes(warm_peer):
+    """THE composed proof (tp mesh): two store-less jax.distributed
+    processes pull over the peer HTTP plane; each host's NETWORK bytes
+    are a strict fraction of the checkpoint; fingerprints agree."""
+    peer_url, tensors, weight_nbytes = warm_peer
+    outs = _run_workers(peer_url, "tp")
+    for o in outs:
+        assert o["network_bytes"] < weight_nbytes, \
+            f"host {o['pid']} fetched the full checkpoint over HTTP " \
+            f"({o['network_bytes']} of {weight_nbytes})"
+        # its shards + 1/2 of the big replicated tensor + headers/slack
+        assert o["network_bytes"] <= weight_nbytes * 0.62
+    # together the pod fetched each byte about once (headers + the small
+    # non-ici replicated bias are the only double-reads)
+    total = sum(o["network_bytes"] for o in outs)
+    assert weight_nbytes <= total <= weight_nbytes * 1.15
+    assert outs[0]["fp"] == outs[1]["fp"]
+
+
+def test_pod_pull_ici_completion_dp(warm_peer):
+    """dp mesh: EVERY tensor replicates, yet each host fetches only ~1/2
+    of the bytes — the all-gather over ICI moves the rest. Replicas are
+    complete and source-exact on both hosts (VERDICT r3 #4)."""
+    peer_url, tensors, weight_nbytes = warm_peer
+    outs = _run_workers(peer_url, "dp")
+    for o in outs:
+        assert o["network_bytes"] < weight_nbytes, \
+            f"host {o['pid']} fetched everything — ICI completion inactive"
+        assert o["network_bytes"] <= weight_nbytes * 0.62
+    assert outs[0]["fp"] == outs[1]["fp"]
+    want_sum = float(tensors["replicated.big"].astype(np.float64).sum())
+    for o in outs:
+        assert o["rep_shape"] == [512, 64]
+        assert abs(o["rep_local_sum"] - want_sum) < 1e-6 * max(
+            1.0, abs(want_sum))
