@@ -36,6 +36,7 @@ through the same delivery plane.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
@@ -243,22 +244,51 @@ class HTTPRestoreCheckpointHandler(ocp.CheckpointHandler):
 
     # -- save -----------------------------------------------------------
     def save(self, directory=None, args: HTTPSaveArgs | None = None):
-        """Serialize the pytree as one safetensors blob and ``PUT`` it to
-        the node (committed to the store + registered for restore)."""
+        """Streamed per-tensor push (VERDICT r3 #7): each tensor is
+        materialized on the host ONE AT A TIME, digested, skipped when the
+        node already holds its bytes (content-address dedup — an unchanged
+        tensor in a checkpoint loop is never re-transferred), and PUT as a
+        single-tensor safetensors blob otherwise. Peak client RAM is
+        O(largest tensor), not O(checkpoint); the server streams too. A
+        final commit registers the model from the ordered digest list."""
         if args is None:
             raise ValueError("pass args=HTTPSaveArgs(item=..., model=...)")
         from demodel_tpu.formats import safetensors as st
 
         flat = _flatten_tree(args.item)
-        host = {name: np.asarray(a) for name, a in flat.items()}
-        blob = st.serialize(host)
-        r = self._session.put(
-            f"{self.endpoint}/restore/{args.model}/safetensors",
-            data=blob, timeout=self.timeout,
-            headers={"Content-Type": "application/octet-stream"})
+        digests: list[str] = []
+        pushed = skipped = 0
+        sent_bytes = 0
+        for name, a in flat.items():
+            # one tensor at a time: host copy + its blob are the only
+            # per-iteration allocations, freed before the next tensor
+            blob = st.serialize({name: np.asarray(a)})
+            digest = hashlib.sha256(blob).hexdigest()
+            digests.append(digest)
+            probe = self._session.get(
+                f"{self.endpoint}/restore/blob/{digest}",
+                timeout=self.timeout)
+            if probe.status_code == 200:
+                skipped += 1
+                continue
+            r = self._session.put(
+                f"{self.endpoint}/restore/blob/{digest}", data=blob,
+                timeout=self.timeout,
+                headers={"Content-Type": "application/octet-stream"})
+            r.raise_for_status()
+            pushed += 1
+            sent_bytes += len(blob)
+        r = self._session.post(
+            f"{self.endpoint}/restore/{args.model}/commit",
+            json={"digests": digests}, timeout=self.timeout)
         r.raise_for_status()
-        log.info("orbax-http saved %s: %d tensors (%.1f MB) to %s",
-                 args.model, len(host), len(blob) / 1e6, self.endpoint)
+        log.info("orbax-http saved %s: %d tensors (%d pushed, %.1f MB sent; "
+                 "%d deduped) to %s", args.model, len(digests), pushed,
+                 sent_bytes / 1e6, skipped, self.endpoint)
+        # ocp.Checkpointer discards this; direct callers (save_pytree) get
+        # the dedup accounting for tests/telemetry
+        return {"tensors": len(digests), "pushed": pushed,
+                "skipped": skipped, "sent_bytes": sent_bytes}
 
     @classmethod
     def typestr(cls) -> str:
@@ -290,7 +320,8 @@ def restore_pytree(endpoint: str, model: str, item=None, mesh=None,
                                           plan=plan, cast_to=cast_to))
 
 
-def save_pytree(endpoint: str, model: str, item) -> None:
-    """Push a pytree to a node's restore surface (safetensors over PUT)."""
+def save_pytree(endpoint: str, model: str, item) -> dict:
+    """Push a pytree to a node's restore surface (streamed, per-tensor,
+    content-deduped). Returns {tensors, pushed, skipped, sent_bytes}."""
     h = HTTPRestoreCheckpointHandler(endpoint)
-    h.save(args=HTTPSaveArgs(item=item, model=model))
+    return h.save(args=HTTPSaveArgs(item=item, model=model))

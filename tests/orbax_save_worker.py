@@ -1,0 +1,60 @@
+"""Worker for the bounded-RSS streamed-save proof (VERDICT r3 #7).
+
+Builds an N×M-MB state of CPU-jax arrays, records RSS, then pushes it to
+a restore node with the streamed per-tensor save. The parent asserts the
+save added only O(largest tensor) to the high-water mark — the old
+whole-blob save added ~2× the full checkpoint.
+
+Prints one JSON line:
+{"rss_before": B, "rss_hwm": B, "state_bytes": B, "tensor_bytes": B,
+ "stats": {...save stats...}}
+"""
+
+import json
+import os
+import resource
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from demodel_tpu.restore.orbax_http import save_pytree  # noqa: E402
+
+endpoint = sys.argv[1]
+model = sys.argv[2]
+n_tensors = int(sys.argv[3])
+mb_per_tensor = int(sys.argv[4])
+
+
+def _maxrss() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+elems = mb_per_tensor << 20 >> 2  # f32
+block = np.arange(1 << 18, dtype=np.float32)
+state = {}
+for i in range(n_tensors):
+    a = np.tile(block, elems // block.size)
+    a[0] = float(i)  # distinct content per tensor (no cross-tensor dedup)
+    state[f"layer{i}.w"] = jax.device_put(a.reshape(-1, 1 << 10))
+    del a
+jax.block_until_ready(list(state.values()))
+
+rss_before = _maxrss()
+stats = save_pytree(endpoint, model, state)
+rss_hwm = _maxrss()
+
+print(json.dumps({
+    "rss_before": rss_before,
+    "rss_hwm": rss_hwm,
+    "state_bytes": n_tensors * (mb_per_tensor << 20),
+    "tensor_bytes": mb_per_tensor << 20,
+    "stats": stats,
+}), flush=True)

@@ -149,3 +149,72 @@ def test_orbax_http_save_roundtrip(served_model, mesh8, tmp_path):
     assert r.status_code == 400
     models = requests.get(f"{endpoint}/restore/models", timeout=10).json()
     assert "org-bad" not in models["models"]
+
+
+def test_streamed_save_dedups_unchanged_tensors(served_model, mesh8):
+    """VERDICT r3 #7: the per-tensor save re-transfers ONLY changed
+    tensors — a checkpoint loop pushing a mostly-unchanged state sends a
+    tensor's bytes, not the checkpoint's."""
+    from demodel_tpu.restore.orbax_http import restore_pytree, save_pytree
+
+    *_, endpoint = served_model
+    rng = np.random.default_rng(11)
+    state = {f"layer{i}.w": rng.standard_normal((64, 32)).astype(np.float32)
+             for i in range(4)}
+    first = save_pytree(endpoint, "org/loop", state)
+    assert first["pushed"] == 4 and first["skipped"] == 0
+
+    # identical re-push: nothing re-transferred, registration still works
+    second = save_pytree(endpoint, "org/loop", state)
+    assert second["pushed"] == 0 and second["skipped"] == 4
+    assert second["sent_bytes"] == 0
+
+    # one tensor trained further → exactly one blob crosses the wire
+    state["layer2.w"] = state["layer2.w"] + 1.0
+    third = save_pytree(endpoint, "org/loop", state)
+    assert third["pushed"] == 1 and third["skipped"] == 3
+
+    tree = restore_pytree(endpoint, "org/loop", mesh=mesh8)
+    np.testing.assert_array_equal(np.asarray(tree["layer2"]["w"]),
+                                  state["layer2.w"])
+    np.testing.assert_array_equal(np.asarray(tree["layer0"]["w"]),
+                                  state["layer0.w"])
+
+    # a commit referencing an unpushed digest is rejected atomically
+    import requests
+    r = requests.post(f"{endpoint}/restore/org-ghost/commit",
+                      json={"digests": ["ab" * 32]}, timeout=10)
+    assert r.status_code == 400
+    models = requests.get(f"{endpoint}/restore/models", timeout=10).json()
+    assert "org-ghost" not in models["models"]
+
+
+@pytest.mark.scale
+def test_streamed_save_bounded_rss(served_model, tmp_path):
+    """Multi-GB save: peak host RAM added by save() is O(largest tensor),
+    not O(checkpoint) — the r03 whole-blob save added ~2× the state."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    *_, endpoint = served_model
+    worker = Path(__file__).parent / "orbax_save_worker.py"
+    n, mb = 12, 128  # 1.5 GiB state
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, str(worker), endpoint, "org/big", str(n), str(mb)],
+        capture_output=True, text=True, timeout=1200, env=env)
+    assert r.returncode == 0, f"save worker failed:\n{r.stdout}\n{r.stderr[-3000:]}"
+    o = _json.loads(r.stdout.strip().splitlines()[-1])
+    assert o["stats"]["pushed"] == n
+    added = o["rss_hwm"] - o["rss_before"]
+    # per-iteration transient: host view + blob + HTTP buffering of ONE
+    # tensor (plus allocator slack) — far under the 1.5 GiB state, and
+    # catastrophically under the old save's ~2×state
+    bound = 4 * o["tensor_bytes"] + (256 << 20)
+    assert added < bound, \
+        f"save added {added >> 20} MB RSS (state {o['state_bytes'] >> 20} " \
+        f"MB, bound {bound >> 20} MB) — not O(largest tensor)"
