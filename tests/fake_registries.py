@@ -311,6 +311,25 @@ def make_ollama_handler(models: dict[str, dict], blobs: dict[str, bytes],
                 if body is None:
                     self._send(404, b'{"errors":[{"code":"BLOB_UNKNOWN"}]}')
                     return
+                # the real registry CDN is range-capable — required for the
+                # proxy's forwarded-window path when fill policy declines
+                rng_hdr = self.headers.get("Range", "")
+                if rng_hdr.startswith("bytes="):
+                    a, _, b = rng_hdr[6:].partition("-")
+                    start = int(a) if a else max(0, len(body) - int(b))
+                    end = min(int(b), len(body) - 1) if (a and b) else \
+                        len(body) - 1
+                    if start > end or start >= len(body):
+                        self._send(416, b"", extra={
+                            "Content-Range": f"bytes */{len(body)}"})
+                        return
+                    self._count("blob-range")
+                    self._send(206, body[start:end + 1],
+                               ctype="application/octet-stream",
+                               extra={"Content-Range":
+                                      f"bytes {start}-{end}/{len(body)}",
+                                      "Accept-Ranges": "bytes"})
+                    return
                 self._send(200, body, ctype="application/octet-stream")
                 return
             self._send(404, b"{}")

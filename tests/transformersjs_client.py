@@ -1,0 +1,102 @@
+"""Client-faithful transformers.js fetch sequence (VERDICT r3 #8).
+
+Reproduces the wire shape ``@huggingface/transformers`` (transformers.js)
+produces when a BROWSER loads a model through the proxy
+(`/root/reference/README.md:14-21` puts transformers.js in the client
+matrix). Node is not in this image, so this mirrors the ollama approach:
+a standalone subprocess emitting the exact request sequence the real
+client's ``fetch`` calls generate:
+
+- cross-origin + custom headers ⇒ the browser sends a CORS **preflight**
+  (``OPTIONS`` + ``Origin`` + ``Access-Control-Request-Method/Headers``)
+  before every distinct resource; the response must grant the origin or
+  the real client never issues the GET;
+- resource ``GET``\\ s carry ``Origin`` and must come back with
+  ``Access-Control-Allow-Origin`` (the browser enforces it on the
+  response too);
+- weight files are also read **ranged** (the streaming/partial-read path)
+  and revalidated with ``If-None-Match`` on the captured ``ETag`` (the
+  browser Cache API's revalidation), accepting 304 or a full 200.
+
+Proxying comes from the environment (HTTPS_PROXY + REQUESTS_CA_BUNDLE),
+exactly like a browser behind a system proxy.
+
+Usage: transformersjs_client.py <endpoint> <model> <dest>
+Prints one JSON line.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import requests
+
+ORIGIN = "https://webml-demo.example"
+
+FILES = ["config.json", "tokenizer.json", "tokenizer_config.json",
+         "onnx/model.onnx"]
+
+
+def preflight(sess: requests.Session, url: str, req_headers: str) -> dict:
+    r = sess.options(url, headers={
+        "Origin": ORIGIN,
+        "Access-Control-Request-Method": "GET",
+        "Access-Control-Request-Headers": req_headers,
+    }, timeout=60)
+    acao = r.headers.get("Access-Control-Allow-Origin", "")
+    if r.status_code >= 400 or acao not in ("*", ORIGIN):
+        raise SystemExit(f"preflight denied for {url}: {r.status_code} "
+                         f"ACAO={acao!r}")
+    return {"status": r.status_code, "acao": acao,
+            "allow_headers": r.headers.get("Access-Control-Allow-Headers", "")}
+
+
+def main() -> int:
+    endpoint, model, dest = sys.argv[1], sys.argv[2], Path(sys.argv[3])
+    dest.mkdir(parents=True, exist_ok=True)
+    sess = requests.Session()
+    out = {"files": {}, "preflights": 0, "etag_revalidated": 0}
+
+    for name in FILES:
+        url = f"{endpoint}/{model}/resolve/main/{name}"
+        preflight(sess, url, "range")
+        out["preflights"] += 1
+        r = sess.get(url, headers={"Origin": ORIGIN}, timeout=300)
+        r.raise_for_status()
+        acao = r.headers.get("Access-Control-Allow-Origin", "")
+        if acao not in ("*", ORIGIN):
+            raise SystemExit(f"GET {name}: response lacks usable ACAO "
+                             f"({acao!r}) — a browser would discard it")
+        body = r.content
+        p = dest / name.replace("/", "_")
+        p.write_bytes(body)
+        out["files"][name] = {"bytes": len(body),
+                              "etag": r.headers.get("ETag", "")}
+
+    # streaming/partial read of the weight file, still cross-origin
+    wurl = f"{endpoint}/{model}/resolve/main/onnx/model.onnx"
+    r = sess.get(wurl, headers={"Origin": ORIGIN, "Range": "bytes=0-1023"},
+                 timeout=60)
+    if r.status_code not in (200, 206):
+        raise SystemExit(f"ranged weight read failed: {r.status_code}")
+    out["ranged_status"] = r.status_code
+    out["ranged_acao"] = r.headers.get("Access-Control-Allow-Origin", "")
+
+    # Cache-API revalidation on the captured ETag
+    for name in FILES:
+        etag = out["files"][name]["etag"]
+        if not etag:
+            continue
+        url = f"{endpoint}/{model}/resolve/main/{name}"
+        r = sess.get(url, headers={"Origin": ORIGIN,
+                                   "If-None-Match": etag}, timeout=60)
+        if r.status_code == 304 or (r.status_code == 200 and
+                                    r.headers.get("ETag", "") == etag):
+            out["etag_revalidated"] += 1
+
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+"""Client-faithful vLLM cold-start (BASELINE config 4; VERDICT r3 #5).
+
+Reproduces the exact wire sequence vLLM's default model loader performs
+when cold-starting from the HF Hub through ``HTTPS_PROXY``
+(`/root/reference/README.md:16-19` names vLLM/SGLang in the client
+matrix):
+
+1. ``GET /api/models/{repo}/revision/{rev}`` — sibling listing (what
+   ``huggingface_hub.snapshot_download`` resolves first);
+2. small files (config/tokenizer/index) via plain ``GET /resolve``;
+3. every ``.safetensors`` shard the **hf_transfer way**: resolve the CDN
+   redirect once, then N parallel ranged ``GET``\\ s of ~chunk-sized
+   windows — the multi-connection ranged-read shape that hammers a cold
+   proxy cache (ranged-miss fill) and a warm one (range-from-cache);
+4. parse the shards and ``device_put`` every tensor — the load "ends in
+   HBM" exactly like vLLM's weight loading step.
+
+Proxying comes entirely from the environment (HTTPS_PROXY +
+REQUESTS_CA_BUNDLE), as with the real client.
+
+Usage: vllm_load_client.py <endpoint> <model> <dest> [chunk_mb] [workers]
+Prints one JSON line with timings/bytes/fingerprints.
+"""
+
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import requests
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def fetch_ranged(sess: requests.Session, url: str, size: int, dest: Path,
+                 chunk_bytes: int, workers: int) -> int:
+    """hf_transfer-shaped download: pre-size the file, fan ranged GETs of
+    ``chunk_bytes`` windows over a thread pool. Returns request count."""
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    with open(dest, "wb") as f:
+        f.truncate(size)
+    ranges = [(off, min(size, off + chunk_bytes) - 1)
+              for off in range(0, size, chunk_bytes)]
+
+    def one(rng):
+        a, b = rng
+        r = sess.get(url, headers={"Range": f"bytes={a}-{b}"}, timeout=300)
+        r.raise_for_status()
+        if r.status_code != 206:
+            raise RuntimeError(f"expected 206 for {a}-{b}, got {r.status_code}")
+        body = r.content
+        if len(body) != b - a + 1:
+            raise RuntimeError(f"short range body: {len(body)}")
+        with open(dest, "r+b") as f:
+            f.seek(a)
+            f.write(body)
+        return 1
+
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        return sum(ex.map(one, ranges))
+
+
+def main() -> int:
+    endpoint, model, dest = sys.argv[1], sys.argv[2], Path(sys.argv[3])
+    chunk_mb = int(sys.argv[4]) if len(sys.argv) > 4 else 10
+    workers = int(sys.argv[5]) if len(sys.argv) > 5 else 8
+    sess = requests.Session()
+
+    t0 = time.perf_counter()
+    info = sess.get(f"{endpoint}/api/models/{model}/revision/main",
+                    timeout=60)
+    info.raise_for_status()
+    siblings = [s["rfilename"] for s in info.json()["siblings"]]
+
+    small = [n for n in siblings if not n.endswith(".safetensors")]
+    shards = [n for n in siblings if n.endswith(".safetensors")]
+    for name in small:
+        r = sess.get(f"{endpoint}/{model}/resolve/main/{name}", timeout=60)
+        r.raise_for_status()
+        p = dest / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(r.content)
+
+    range_requests = 0
+    total_bytes = 0
+    for name in shards:
+        # resolve once (redirect to CDN), then ranged fan-out on the final
+        # URL — hf_transfer receives the resolved URL from huggingface_hub
+        h = sess.get(f"{endpoint}/{model}/resolve/main/{name}",
+                     headers={"Range": "bytes=0-0"}, timeout=60)
+        h.raise_for_status()
+        size = int(h.headers["Content-Range"].rpartition("/")[2])
+        final_url = h.url
+        range_requests += fetch_ranged(sess, final_url, size, dest / name,
+                                       chunk_mb << 20, workers)
+        total_bytes += size
+    download_secs = time.perf_counter() - t0
+
+    # ---- vLLM's weight-loading step: parse + device_put (→ HBM)
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from demodel_tpu.formats import safetensors as st
+
+    arrays = {}
+    for name in shards:
+        blob = (dest / name).read_bytes()
+        idx = st.parse_header(blob)
+        for tname, spec in idx.tensors.items():
+            arrays[tname] = jax.device_put(
+                spec.to_numpy(blob[spec.start:spec.end]))
+    jax.block_until_ready(list(arrays.values()))
+    load_secs = time.perf_counter() - t0 - download_secs
+
+    fp = {n: float(np.asarray(a, dtype=np.float64).sum())
+          for n, a in sorted(arrays.items())}
+    print(json.dumps({
+        "download_secs": round(download_secs, 3),
+        "load_secs": round(load_secs, 3),
+        "total_secs": round(download_secs + load_secs, 3),
+        "bytes": total_bytes,
+        "range_requests": range_requests,
+        "tensors": len(arrays),
+        "fp": fp,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
