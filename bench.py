@@ -337,7 +337,7 @@ def main():
     if "--fallback-child" in sys.argv:
         print(json.dumps(_bench_fallback()))
         return
-    for kind, timeout in (("e2e", 900), ("fallback", 300)):
+    for kind, timeout in (("e2e", 1200), ("fallback", 300)):
         try:
             out = _run_guarded(kind, timeout)
             if out is not None:

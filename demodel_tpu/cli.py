@@ -177,14 +177,27 @@ def _cmd_pull(cfg: ProxyConfig, args) -> int:
     from demodel_tpu.delivery import pull
 
     try:
-        report = pull(
-            args.model,
-            cfg,
-            source=args.source,
-            sink=args.sink,
-            revision=args.revision,
-            peers=args.peer or None,
-        )
+        if getattr(args, "sharded", False):
+            # pod shape: shard-reads straight off a warm peer's manifest —
+            # each host fetches only its devices' byte windows (DCN) and
+            # replicated tensors complete over ICI (sink/remote.py)
+            if not args.peer:
+                print("--sharded requires at least one --peer",
+                      file=sys.stderr)
+                return 2
+            from demodel_tpu.sink.remote import pull_manifest_to_hbm
+
+            report, _placed = pull_manifest_to_hbm(
+                args.model, args.peer, source=args.source)
+        else:
+            report = pull(
+                args.model,
+                cfg,
+                source=args.source,
+                sink=args.sink,
+                revision=args.revision,
+                peers=args.peer or None,
+            )
     except Exception as e:  # noqa: BLE001 — CLI boundary: no raw tracebacks
         print(f"pull failed: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
@@ -269,6 +282,10 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--revision", default="main")
     pl.add_argument("--peer", action="append", default=[],
                     help="peer node base URL tried before upstream (repeatable)")
+    pl.add_argument("--sharded", action="store_true",
+                    help="pod pull: read only this host's shard windows "
+                         "off a warm peer's manifest, straight to HBM "
+                         "(implies --sink=tpu; requires --peer)")
     sv = sub.add_parser("serve", help="run proxy + peer + restore APIs")
     sv.add_argument("--restore-port", type=int, default=8081)
     g = sub.add_parser("gc", help="evict LRU cache entries to a size cap")

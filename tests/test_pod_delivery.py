@@ -96,6 +96,24 @@ def test_single_process_wire_parity(warm_peer, mesh8):
     assert report["network_bytes"] <= weight_nbytes * 1.1 + 65536
 
 
+def test_cli_sharded_pull(warm_peer, tmp_path, monkeypatch, capsys):
+    """`demodel-tpu pull --sharded --peer URL` drives the pod path from
+    the CLI (the operator surface of sink/remote.py)."""
+    peer_url, tensors, weight_nbytes = warm_peer
+    monkeypatch.setenv("DEMODEL_PROXY_CACHE_DIR", str(tmp_path / "cli-cache"))
+    monkeypatch.setenv("DEMODEL_PROXY_DATA_DIR", str(tmp_path / "cli-data"))
+    from demodel_tpu import cli
+
+    rc = cli.main(["pull", MODEL, "--sharded", "--peer", peer_url])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["network_bytes"] >= weight_nbytes  # single host reads all
+    # manifest sizes are FILE bytes: tensors + safetensors headers
+    assert weight_nbytes <= out["weight_bytes"] <= weight_nbytes + 4096
+    # and the sharded flag without a peer is a usage error, not a crash
+    assert cli.main(["pull", MODEL, "--sharded"]) == 2
+
+
 def _run_workers(peer_url, mode):
     import os
 
