@@ -178,6 +178,50 @@ def fetch_manifest(peers: list[str], model: str, source: str = "hf",
                   + (f" (last error: {last_err})" if last_err else ""))
 
 
+def _deliver_pipelined(reader: PeerBlobReader, key: str, mesh, plan,
+                       cast_to=None) -> Placement:
+    """Single-process safetensors delivery with a 1-deep tensor prefetch:
+    tensor N+1's byte window downloads (multi-stream, native) while tensor
+    N's ``device_put`` is in flight — wall-clock ≈ max(network, host→HBM)
+    instead of their sum. Only used when this process addresses the whole
+    mesh (a pod host must fetch exactly its shard windows instead —
+    prefetching whole tensors would defeat shard reads)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from demodel_tpu.formats import safetensors as st
+    from demodel_tpu.formats.safetensors import _np_dtype
+    from demodel_tpu.sink.hbm import place_tensor
+
+    index = st.read_index_from(
+        lambda off, ln: reader.pread(key, ln, off),
+        total_size=reader.size(key))
+    items = list(index.tensors.items())
+    out = Placement(mesh_desc=f"{dict(mesh.shape)}")
+
+    def fetch(spec):
+        buf = np.empty(spec.end - spec.start, dtype=np.uint8)
+        reader.pread_into(key, buf, spec.start)
+        return buf
+
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        nxt = ex.submit(fetch, items[0][1]) if items else None
+        for i, (name, spec) in enumerate(items):
+            buf = nxt.result()
+            if i + 1 < len(items):
+                nxt = ex.submit(fetch, items[i + 1][1])
+            mv = memoryview(buf)
+            start = spec.start
+
+            def read_at(off, ln, _mv=mv, _s=start):
+                return _mv[off - _s:off - _s + ln]
+
+            np_dtype = _np_dtype(spec.dtype)
+            sharding = plan.sharding_for(name, spec.shape, np_dtype.itemsize)
+            out.arrays[name] = place_tensor(
+                read_at, spec.shape, np_dtype, spec.start, sharding, cast_to)
+    return out
+
+
 def pull_manifest_to_hbm(
     model: str,
     peers: list[str],
@@ -228,9 +272,13 @@ def pull_manifest_to_hbm(
         reader = PeerBlobReader(peer, key, size, streams=streams)
         readers.append(reader)
         if name.endswith(".safetensors"):
-            placed = deliver_safetensors(
-                reader, key, mesh=mesh, plan=plan, cast_to=cast_to,
-                ici_complete=ici_complete)
+            if jax.process_count() == 1:
+                placed = _deliver_pipelined(reader, key, mesh, plan,
+                                            cast_to=cast_to)
+            else:
+                placed = deliver_safetensors(
+                    reader, key, mesh=mesh, plan=plan, cast_to=cast_to,
+                    ici_complete=ici_complete)
         else:
             from demodel_tpu.sink.hbm import deliver_gguf
 
