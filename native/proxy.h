@@ -88,6 +88,15 @@ struct TensorLoc {
   int64_t nbytes = 0;
 };
 
+// Parallel ranged fetch of one object window [obj_off, obj_off+length)
+// into caller memory over N connections — the shard-read primitive of the
+// sharded pod pull (used via dm_peer_fetch_window; exposed here for the
+// sanitizer selftest).
+int64_t peer_fetch_window(const std::string &host, int port,
+                          const std::string &path, int64_t obj_off,
+                          int64_t length, int64_t obj_total, int streams,
+                          char *out, std::string *err);
+
 class Proxy {
  public:
   explicit Proxy(ProxyConfig cfg);
