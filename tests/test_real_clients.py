@@ -198,7 +198,9 @@ def test_vllm_cold_start_through_proxy(tmp_path):
     shape — sibling listing, then N parallel ranged GETs per multi-shard
     safetensors file — through HTTPS_PROXY, cold and warm, ending with
     every tensor device_put. Warm run: zero new upstream CDN requests
-    (every range served by the proxy) and faster wall-clock."""
+    (every range served by the proxy) and faster wall-clock. SGLang's
+    loader funnels through the same huggingface_hub snapshot_download +
+    hf_transfer machinery, so this sequence covers both named clients."""
     repo = build_hf_repo(seed=9, n_shards=2, rows=120_000)  # ~61 MB total
     handler = make_hf_handler({"demo/vllm": repo})
     with FakeUpstream(handler=handler, tls_dir=tmp_path / "hubca") as hub:

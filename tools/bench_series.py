@@ -1,0 +1,61 @@
+"""Run `bench.py` N times and record every parsed line — the
+measurement protocol for when the axon tunnel recovers (PROFILE_r04.md):
+multiple reps, committed, so the driver-comparable number is a
+distribution rather than one lucky/unlucky sample.
+
+Usage: python tools/bench_series.py [reps] [outfile]
+Appends one JSON object per rep to BENCH_SERIES_r04.jsonl and prints a
+min/median/max summary.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import statistics
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    out_path = Path(sys.argv[2]) if len(sys.argv) > 2 else \
+        REPO / "BENCH_SERIES_r04.jsonl"
+    values = []
+    for i in range(reps):
+        proc = subprocess.run([sys.executable, str(REPO / "bench.py")],
+                              capture_output=True, text=True, timeout=1800)
+        parsed = None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+                break
+            except ValueError:
+                continue
+        rec = {
+            "ts": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+            "rep": i,
+            "parsed": parsed,
+            "stderr_tail": proc.stderr[-1200:],
+        }
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(parsed))
+        if parsed and parsed.get("metric") == "cold_pull_to_hbm_throughput":
+            values.append(float(parsed["value"]))
+    if values:
+        print(f"[series] n={len(values)} min={min(values):.1f} "
+              f"median={statistics.median(values):.1f} "
+              f"max={max(values):.1f} MB/s/chip", file=sys.stderr)
+    else:
+        print("[series] no e2e results (tunnel still down?)",
+              file=sys.stderr)
+    return 0 if values else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
