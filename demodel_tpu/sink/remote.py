@@ -262,6 +262,15 @@ def pull_manifest_to_hbm(
         "network_bytes": 0, "weight_bytes": 0,
     }
     readers: list[PeerBlobReader] = []
+    # failover order: the manifest peer first, then the others. A peer
+    # dying mid-pull costs one file re-read from the next peer, not the
+    # placement. NB every host must converge on the same file→peer choice
+    # for collective pairing; deterministic order + deterministic failure
+    # (a dead peer is dead for all) preserves that in practice, and the
+    # multi-host ici path re-reads windows only, so a divergent retry can
+    # stall but not mispair (same tensors, same order).
+    peer_order = [peer] + [p.rstrip("/") for p in peers
+                           if p.rstrip("/") != peer]
     for f in manifest.get("files", []):
         name, key = f["name"], f["key"]
         if not is_weight_file(name, f.get("media_type", "")):
@@ -269,20 +278,32 @@ def pull_manifest_to_hbm(
         size = int(f.get("size") or 0)
         if size <= 0:
             raise IOError(f"manifest entry {name} lacks a size")
-        reader = PeerBlobReader(peer, key, size, streams=streams)
-        readers.append(reader)
-        if name.endswith(".safetensors"):
-            if jax.process_count() == 1:
-                placed = _deliver_pipelined(reader, key, mesh, plan,
-                                            cast_to=cast_to)
-            else:
-                placed = deliver_safetensors(
-                    reader, key, mesh=mesh, plan=plan, cast_to=cast_to,
-                    ici_complete=ici_complete)
-        else:
-            from demodel_tpu.sink.hbm import deliver_gguf
+        placed = None
+        last_err: Exception | None = None
+        for source_peer in peer_order:
+            reader = PeerBlobReader(source_peer, key, size, streams=streams)
+            try:
+                if name.endswith(".safetensors"):
+                    if jax.process_count() == 1:
+                        placed = _deliver_pipelined(reader, key, mesh, plan,
+                                                    cast_to=cast_to)
+                    else:
+                        placed = deliver_safetensors(
+                            reader, key, mesh=mesh, plan=plan,
+                            cast_to=cast_to, ici_complete=ici_complete)
+                else:
+                    from demodel_tpu.sink.hbm import deliver_gguf
 
-            placed = deliver_gguf(reader, key, mesh=mesh, plan=plan)
+                    placed = deliver_gguf(reader, key, mesh=mesh, plan=plan)
+                readers.append(reader)
+                break
+            except (IOError, OSError, requests.RequestException) as e:
+                last_err = e
+                readers.append(reader)  # count the wasted bytes honestly
+                log.warning("delivery of %s from %s failed (%s); trying "
+                            "next peer", name, source_peer, e)
+        if placed is None:
+            raise IOError(f"no peer could serve {name}") from last_err
         merge_placement(placement, placed)
         report["weight_bytes"] += size
     jax.block_until_ready(list(placement.arrays.values()))

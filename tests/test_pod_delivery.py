@@ -96,6 +96,67 @@ def test_single_process_wire_parity(warm_peer, mesh8):
     assert report["network_bytes"] <= weight_nbytes * 1.1 + 65536
 
 
+def test_sharded_pull_fails_over_to_second_peer(warm_peer, mesh8):
+    """A dead first peer costs a retry, not the placement: the pull fails
+    over to the next peer and still lands byte-exact tensors."""
+    peer_url, tensors, _ = warm_peer
+    from demodel_tpu.sink.remote import pull_manifest_to_hbm
+
+    # a peer that answers nothing (closed port)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    report, placed = pull_manifest_to_hbm(MODEL, [dead, peer_url],
+                                          mesh=mesh8)
+    assert report["peer"] == peer_url  # manifest discovery skipped the dead one
+    for name, want in tensors.items():
+        np.testing.assert_array_equal(np.asarray(placed.arrays[name]), want)
+
+    # mid-pull failure: a peer that serves the MANIFEST but errors on
+    # every object read — file delivery must fail over to the warm peer
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import requests as _rq
+
+    from demodel_tpu.delivery import manifest_key
+
+    mkey = manifest_key("hf", MODEL)
+    manifest_json = _rq.get(f"{peer_url}/peer/object/{mkey}",
+                            timeout=10).content
+
+    class FlakyPeer(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path == f"/peer/object/{mkey}":
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(manifest_json)))
+                self.end_headers()
+                self.wfile.write(manifest_json)
+            else:
+                self.send_response(500)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), FlakyPeer)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    flaky = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        report2, placed2 = pull_manifest_to_hbm(MODEL, [flaky, peer_url],
+                                                mesh=mesh8)
+        assert report2["peer"] == flaky  # manifest came from the flaky peer
+        for name, want in tensors.items():
+            np.testing.assert_array_equal(np.asarray(placed2.arrays[name]),
+                                          want)
+    finally:
+        srv.shutdown()
+
+
 def test_cli_sharded_pull(warm_peer, tmp_path, monkeypatch, capsys):
     """`demodel-tpu pull --sharded --peer URL` drives the pod path from
     the CLI (the operator surface of sink/remote.py)."""
