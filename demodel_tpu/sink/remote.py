@@ -262,15 +262,21 @@ def pull_manifest_to_hbm(
         "network_bytes": 0, "weight_bytes": 0,
     }
     readers: list[PeerBlobReader] = []
-    # failover order: the manifest peer first, then the others. A peer
+    # Failover order: the manifest peer first, then the others. A peer
     # dying mid-pull costs one file re-read from the next peer, not the
-    # placement. NB every host must converge on the same file→peer choice
-    # for collective pairing; deterministic order + deterministic failure
-    # (a dead peer is dead for all) preserves that in practice, and the
-    # multi-host ici path re-reads windows only, so a divergent retry can
-    # stall but not mispair (same tensors, same order).
-    peer_order = [peer] + [p.rstrip("/") for p in peers
-                           if p.rstrip("/") != peer]
+    # placement — but ONLY single-process: on a multi-host mesh a host
+    # that locally retries a file whose earlier tensors already ran their
+    # redistribute() collectives would re-issue those collectives while
+    # the other hosts sit in later ones — same-shaped tensors would pair
+    # silently wrong (corrupt weights), different-shaped ones deadlock.
+    # Multi-host delivery therefore re-raises and lets the caller restart
+    # the pull pod-wide (every host restarts → collective order stays
+    # aligned).
+    if jax.process_count() == 1:
+        peer_order = [peer] + [p.rstrip("/") for p in peers
+                               if p.rstrip("/") != peer]
+    else:
+        peer_order = [peer]
     for f in manifest.get("files", []):
         name, key = f["name"], f["key"]
         if not is_weight_file(name, f.get("media_type", "")):
@@ -297,7 +303,7 @@ def pull_manifest_to_hbm(
                     placed = deliver_gguf(reader, key, mesh=mesh, plan=plan)
                 readers.append(reader)
                 break
-            except (IOError, OSError, requests.RequestException) as e:
+            except OSError as e:  # incl. IOError + requests exceptions
                 last_err = e
                 readers.append(reader)  # count the wasted bytes honestly
                 log.warning("delivery of %s from %s failed (%s); trying "
