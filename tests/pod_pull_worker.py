@@ -51,14 +51,16 @@ report, placed = pull_manifest_to_hbm(
 fps = {name: [float(x) for x in np.asarray(fingerprint(a))]
        for name, a in sorted(placed.arrays.items())}
 
-rep = placed.arrays["replicated.big"]
-local = np.asarray(rep.addressable_shards[0].data)
-
-print(json.dumps({
+out = {
     "pid": pid,
     "network_bytes": report["network_bytes"],
     "weight_bytes": report["weight_bytes"],
     "fp": fps,
-    "rep_local_sum": float(local.astype(np.float64).sum()),
-    "rep_shape": list(rep.shape),
-}), flush=True)
+}
+if not os.environ.get("DEMODEL_POD_SKIP_REP"):
+    rep = placed.arrays["replicated.big"]
+    local = np.asarray(rep.addressable_shards[0].data)
+    out["rep_local_sum"] = float(local.astype(np.float64).sum())
+    out["rep_shape"] = list(rep.shape)
+
+print(json.dumps(out), flush=True)
