@@ -290,6 +290,12 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--restore-port", type=int, default=8081)
     g = sub.add_parser("gc", help="evict LRU cache entries to a size cap")
     g.add_argument("--max-gb", type=int, default=0)
+    mf = sub.add_parser(
+        "manifest",
+        help="synthesize a model manifest from the proxy-warmed cache "
+             "(lets a foreign-client-warmed node seed pod pulls/restore)")
+    mf.add_argument("model")
+    mf.add_argument("--source", default="hf", choices=["hf", "ollama"])
     return p
 
 
@@ -307,6 +313,20 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_serve(cfg, args)
     if cmd == "gc":
         return _cmd_gc(cfg, args)
+    if cmd == "manifest":
+        from demodel_tpu.delivery import open_store, synthesize_manifest
+
+        store = open_store(cfg)
+        try:
+            record = synthesize_manifest(store, args.model,
+                                         source=args.source)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        finally:
+            store.close()
+        print(json.dumps(record, indent=2))
+        return 0
     return _cmd_start(cfg, args)
 
 

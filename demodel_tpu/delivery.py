@@ -285,6 +285,77 @@ def pull_to_hbm(
                 store.close()
 
 
+def synthesize_manifest(store: Store, model: str, source: str = "hf",
+                        persist: bool = True) -> dict:
+    """Build a model-manifest record out of a PROXY-warmed cache — no
+    first-party pull required.
+
+    A peer whose store was populated by foreign clients through the MITM
+    proxy (hf-cli, transformers, vLLM …) holds every byte of the model,
+    but URL-keyed: full objects under their resolve/CDN URIs plus
+    zero-byte LFS redirects carrying the content digest. This walks those
+    entries for ``{model}/resolve/...`` URIs, publishes digest-located
+    blobs under stable keys (hardlink, zero copy), and persists the same
+    manifest record :func:`pull` writes — after which the peer can seed a
+    sharded pod pull (`sink/remote.py`) or a restore registration exactly
+    as if it had pulled first-party. Reference analogy: the proxy cache
+    IS the source of truth ("proxied and cached, automatically",
+    `/root/reference/CONTRIBUTING.md:51`); this makes its contents
+    first-class.
+
+    Raises ``FileNotFoundError`` when no cached files match ``model``.
+    """
+    import re as _re
+
+    from demodel_tpu.store import key_for_uri
+
+    pat = _re.compile(
+        _re.escape(model) + r"/resolve/([^/]+)/(.+)$")
+    files: dict[str, dict] = {}  # filename → entry (first revision wins)
+    for key in store.list():
+        meta = store.meta(key) or {}
+        uri = meta.get("uri", "")
+        m = pat.search(uri.split("?", 1)[0])
+        if not m:
+            continue
+        rev, name = m.group(1), m.group(2)
+        status = int(meta.get("status", 200) or 200)
+        headers = meta.get("headers", {}) or {}
+        if 301 <= status <= 308:
+            # LFS redirect stub: the content lives under the CDN URL /
+            # digest link; publish it under a deterministic key
+            linked = (headers.get("x-linked-etag", "") or "").strip('"')
+            if len(linked) != 64 or not store.has_digest(linked):
+                continue
+            synth_key = key_for_uri(f"demodel://synth/{model}/{name}")
+            if not store.has(synth_key):
+                store.materialize(synth_key, linked, {
+                    "uri": uri, "sha256": linked, "synthesized": True,
+                })
+            entry_key, sha = synth_key, linked
+        elif status == 200 and store.size(key) > 0:
+            entry_key, sha = key, meta.get("sha256", "")
+        else:
+            continue
+        files.setdefault(name, {
+            "name": name, "key": entry_key, "size": store.size(entry_key),
+            "sha256": sha, "revision": rev, "media_type": "",
+        })
+    if not files:
+        raise FileNotFoundError(
+            f"no cached objects match {model}/resolve/ — was the model "
+            "pulled through this proxy?")
+    record = {
+        "name": model, "source": source, "synthesized": True,
+        "files": sorted(files.values(), key=lambda f: f["name"]),
+    }
+    if persist:
+        _persist_manifest(store, manifest_key(source, model), record, set())
+        log.info("synthesized manifest for %s: %d files from the proxy "
+                 "cache", model, len(files))
+    return record
+
+
 def materialize(report: PullReport | dict, store: Store, dest: Path) -> list[Path]:
     """Write a pulled snapshot out of the store into ``dest`` with original
     filenames — what a foreign tool (``transformers.from_pretrained``)

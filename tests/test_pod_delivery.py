@@ -161,8 +161,8 @@ def test_cli_sharded_pull(warm_peer, tmp_path, monkeypatch, capsys):
     """`demodel-tpu pull --sharded --peer URL` drives the pod path from
     the CLI (the operator surface of sink/remote.py)."""
     peer_url, tensors, weight_nbytes = warm_peer
-    monkeypatch.setenv("DEMODEL_PROXY_CACHE_DIR", str(tmp_path / "cli-cache"))
-    monkeypatch.setenv("DEMODEL_PROXY_DATA_DIR", str(tmp_path / "cli-data"))
+    monkeypatch.setenv("DEMODEL_CACHE_DIR", str(tmp_path / "cli-cache"))
+    monkeypatch.setenv("DEMODEL_DATA_DIR", str(tmp_path / "cli-data"))
     from demodel_tpu import cli
 
     rc = cli.main(["pull", MODEL, "--sharded", "--peer", peer_url])
@@ -212,6 +212,68 @@ def test_pod_pull_splits_network_bytes(warm_peer):
     total = sum(o["network_bytes"] for o in outs)
     assert weight_nbytes <= total <= weight_nbytes * 1.15
     assert outs[0]["fp"] == outs[1]["fp"]
+
+
+def test_synthesized_manifest_from_proxy_warmed_cache(tmp_path, mesh8,
+                                                      monkeypatch):
+    """A node warmed ONLY by a foreign client through the MITM proxy (no
+    first-party pull, so no manifest record) can still seed a sharded pod
+    pull: `demodel-tpu manifest` synthesizes the record from the
+    URL-keyed cache (following LFS-redirect digest links), after which
+    pull_manifest_to_hbm lands byte-exact tensors."""
+    import requests as _rq
+
+    from demodel_tpu import pki
+    from demodel_tpu.sink.remote import pull_manifest_to_hbm
+
+    # the image sets these globally and they override Session.verify
+    for var in ("REQUESTS_CA_BUNDLE", "CURL_CA_BUNDLE"):
+        monkeypatch.delenv(var, raising=False)
+
+    files, tensors = _build_pod_repo()
+    handler = make_hf_handler({MODEL: files})
+    from .servers import FakeUpstream as _FU
+
+    with _FU(handler=handler, tls_dir=tmp_path / "hubca") as hub:
+        cfg = ProxyConfig(host="127.0.0.1", port=0,
+                          mitm_hosts=[hub.authority],
+                          cache_dir=tmp_path / "fw-cache",
+                          data_dir=tmp_path / "fw-data", use_ecdsa=True)
+        with ProxyServer(cfg, upstream_ca=str(hub.ca_path),
+                         verbose=False) as proxy:
+            # the foreign client: plain HTTPS GETs through the proxy
+            # (hf-cli shape — resolve → follow redirect → CDN)
+            s = _rq.Session()
+            s.proxies = {"https": f"http://127.0.0.1:{proxy.port}"}
+            s.verify = str(pki.ca_paths(cfg.data_dir)[0])
+            for name in files:
+                r = s.get(f"https://{hub.authority}/{MODEL}/resolve/main/"
+                          f"{name}", timeout=60)
+                r.raise_for_status()
+
+            # no manifest yet → sharded pull must fail
+            with pytest.raises(IOError):
+                from demodel_tpu.sink.remote import fetch_manifest
+                fetch_manifest([proxy.url], MODEL)
+
+            # synthesize from the proxy cache via the CLI surface
+            import demodel_tpu.cli as cli
+            import os
+
+            os.environ["DEMODEL_CACHE_DIR"] = str(tmp_path / "fw-cache")
+            os.environ["DEMODEL_DATA_DIR"] = str(tmp_path / "fw-data")
+            try:
+                assert cli.main(["manifest", MODEL]) == 0
+            finally:
+                os.environ.pop("DEMODEL_CACHE_DIR")
+                os.environ.pop("DEMODEL_DATA_DIR")
+
+            report, placed = pull_manifest_to_hbm(MODEL, [proxy.url],
+                                                  mesh=mesh8)
+            assert set(placed.arrays) == set(tensors)
+            for name, want in tensors.items():
+                np.testing.assert_array_equal(
+                    np.asarray(placed.arrays[name]), want)
 
 
 def test_pod_pull_15_shard_stream(tmp_path):
