@@ -309,6 +309,8 @@ def synthesize_manifest(store: Store, model: str, source: str = "hf",
 
     from demodel_tpu.store import key_for_uri
 
+    if source == "ollama":
+        return _synthesize_ollama_manifest(store, model, persist=persist)
     pat = _re.compile(
         _re.escape(model) + r"/resolve/([^/]+)/(.+)$")
     files: dict[str, dict] = {}  # filename → entry (first revision wins)
@@ -353,6 +355,106 @@ def synthesize_manifest(store: Store, model: str, source: str = "hf",
         _persist_manifest(store, manifest_key(source, model), record, set())
         log.info("synthesized manifest for %s: %d files from the proxy "
                  "cache", model, len(files))
+    return record
+
+
+def _synthesize_ollama_manifest(store: Store, model: str,
+                                persist: bool = True) -> dict:
+    """Ollama flavor of :func:`synthesize_manifest`: the proxy cached the
+    registry-v2 manifest under its ``/v2/{name}/manifests/{tag}`` URI and
+    every layer under its ``blobs/{digest}`` URI — resolve the manifest,
+    map layers to their cached blob keys, persist the pull-shaped
+    record."""
+    import json as _json
+
+    from demodel_tpu.registry.ollama import normalize_name
+    from demodel_tpu.store import key_for_uri
+
+    name, tag = normalize_name(model)
+    suffix = f"/v2/{name}/manifests/{tag}"
+    manifest = None
+    manifest_uri = None
+    for key in store.list():
+        meta = store.meta(key) or {}
+        uri = meta.get("uri", "")
+        if not uri.split("?", 1)[0].endswith(suffix):
+            continue
+        try:
+            manifest = _json.loads(b"".join(store.stream(key)).decode())
+            manifest_uri = uri
+            break
+        except ValueError:
+            continue
+    if manifest is None:
+        raise FileNotFoundError(
+            f"no cached registry-v2 manifest matches {suffix} — was "
+            "the model pulled through this proxy?")
+    base = manifest_uri.split("?", 1)[0][: -len(suffix)]
+    # blob URI → cached key, INCLUDING auth-scoped entries: a wire pull
+    # through the registry token dance caches blobs under credentialed
+    # keys (private, no digest link — gated bytes must never launder into
+    # the public index automatically). Synthesis is the operator
+    # explicitly re-sharing this model, so those entries are located by
+    # their recorded URI and re-published below with digest verification.
+    by_uri: dict[str, str] = {}
+    for key in store.list():
+        meta = store.meta(key) or {}
+        uri = (meta.get("uri") or "").split("?", 1)[0]
+        if f"/v2/{name}/blobs/" in uri:
+            by_uri.setdefault(uri, key)
+    files = []
+    layers = list(manifest.get("layers", []))
+    if manifest.get("config"):
+        layers.append(manifest["config"])
+    for layer in layers:
+        digest = layer.get("digest", "")
+        sha = digest.split(":", 1)[-1]
+        blob_uri = f"{base}/v2/{name}/blobs/{digest}"
+        blob_key = key_for_uri(blob_uri)
+        if not store.has(blob_key):
+            src_key = by_uri.get(blob_uri)
+            if src_key is None and not store.has_digest(sha):
+                raise FileNotFoundError(
+                    f"layer {digest[:19]} of {model} not in the cache")
+            blob_key = key_for_uri(f"demodel://synth/{model}/{sha}")
+            if not store.has(blob_key):
+                pub_meta = {"uri": blob_uri, "sha256": sha,
+                            "synthesized": True}
+                if src_key is None:
+                    # public bytes already digest-indexed: zero-copy link
+                    store.materialize(blob_key, sha, pub_meta)
+                else:
+                    # auth-scoped copy: re-hash while copying — the
+                    # manifest digest is the integrity proof that these
+                    # are exactly the registry's content-addressed bytes
+                    w = store.begin(blob_key)
+                    try:
+                        for chunk in store.stream(src_key):
+                            w.append(chunk)
+                        if w.digest() != sha:
+                            w.abort(keep_partial=False)
+                            raise IOError(
+                                f"cached layer {digest[:19]} does not "
+                                "match its manifest digest")
+                        w.commit(pub_meta)
+                    except BaseException:
+                        if w._open:  # noqa: SLF001 — writer state check
+                            w.abort(keep_partial=False)
+                        raise
+        files.append({
+            "name": digest.split(":", 1)[-1],
+            "key": blob_key,
+            "size": int(layer.get("size") or store.size(blob_key)),
+            "sha256": digest.split(":", 1)[-1],
+            "media_type": layer.get("mediaType", ""),
+        })
+    record = {"name": model, "source": "ollama", "synthesized": True,
+              "files": sorted(files, key=lambda f: f["name"])}
+    if persist:
+        _persist_manifest(store, manifest_key("ollama", model), record,
+                          set())
+        log.info("synthesized ollama manifest for %s: %d layers", model,
+                 len(files))
     return record
 
 

@@ -547,6 +547,42 @@ def test_ollama_blob_scale_to_hbm(tmp_path, monkeypatch, mesh8):
                        for f in report2["files"])
 
 
+def test_ollama_manifest_synthesis_from_proxy_cache(ollama_rig, tmp_path):
+    """An ollama-wire-warmed proxy cache (no first-party pull) can
+    synthesize the pull-shaped manifest record: layers resolve to their
+    cached blob keys, and the record is immediately peer-servable."""
+    reg, proxy, manifest, blobs, handler = ollama_rig
+    client = Path(__file__).parent / "ollama_pull_client.py"
+    _run([sys.executable, str(client), f"https://{reg.authority}",
+          "tiny:latest", str(tmp_path / "seed")], _ollama_env(proxy))
+
+    from demodel_tpu.delivery import synthesize_manifest
+    from demodel_tpu.store import Store
+
+    store = Store(proxy.cfg.cache_dir / "proxy")
+    try:
+        record = synthesize_manifest(store, "tiny:latest", source="ollama")
+        by_name = {f["name"]: f for f in record["files"]}
+        for layer in manifest["layers"] + [manifest["config"]]:
+            sha = layer["digest"].split(":", 1)[1]
+            assert sha in by_name
+            assert by_name[sha]["size"] == layer["size"]
+            assert store.size(by_name[sha]["key"]) == layer["size"]
+        model_sha = manifest["layers"][0]["digest"].split(":", 1)[1]
+        assert by_name[model_sha]["media_type"] == \
+            "application/vnd.ollama.image.model"
+    finally:
+        store.close()
+
+    # the record is live on the peer plane right away
+    from demodel_tpu.sink.remote import fetch_manifest
+
+    peer, served = fetch_manifest([proxy.url], "tiny:latest",
+                                  source="ollama")
+    assert served["synthesized"] is True
+    assert len(served["files"]) == len(record["files"])
+
+
 def test_ollama_offline_replay_after_registry_death(ollama_rig, tmp_path):
     """Warm proxy + dead registry: the full registry-v2 flow (including the
     token endpoint and manifest) replays from cache."""
