@@ -327,6 +327,57 @@ def test_pod_pull_15_shard_stream(tmp_path):
     assert len(outs[0]["fp"]) == 15
 
 
+def test_pod_pull_gguf_over_wire(tmp_path, mesh8):
+    """GGUF on the pod path: a warm node that pulled an ollama model
+    serves it over /peer; a cold store-less consumer places the Q8_0
+    tensors via ranged reads + on-device dequant, values within the
+    quantization error of the ORIGINAL floats."""
+    import hashlib
+
+    from demodel_tpu.formats import gguf as gguf_mod
+    from demodel_tpu.sink.remote import pull_manifest_to_hbm
+
+    from .fake_registries import make_ollama_handler
+
+    rng = np.random.default_rng(17)
+    tensors = {"blk.0.w": rng.standard_normal((64, 256)).astype(np.float32),
+               "blk.1.w": rng.standard_normal((64, 256)).astype(np.float32)}
+    gguf_blob = gguf_mod.serialize(tensors, types=gguf_mod.GGML_Q8_0)
+    config_blob = json.dumps({"model_format": "gguf"}).encode()
+
+    def dig(b):
+        return "sha256:" + hashlib.sha256(b).hexdigest()
+
+    manifest = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.docker.distribution.manifest.v2+json",
+        "config": {"mediaType":
+                   "application/vnd.docker.container.image.v1+json",
+                   "digest": dig(config_blob), "size": len(config_blob)},
+        "layers": [{"mediaType": "application/vnd.ollama.image.model",
+                    "digest": dig(gguf_blob), "size": len(gguf_blob)}],
+    }
+    handler = make_ollama_handler(
+        {"library/gg:latest": manifest},
+        {dig(gguf_blob): gguf_blob, dig(config_blob): config_blob})
+    with FakeUpstream(handler=handler) as reg:
+        cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                          cache_dir=tmp_path / "gg-cache",
+                          data_dir=tmp_path / "gg-data", use_ecdsa=True)
+        delivery.pull("gg:latest", cfg, source="ollama",
+                      endpoint=f"http://{reg.authority}")
+        with ProxyServer(cfg, verbose=False) as peer:
+            report, placed = pull_manifest_to_hbm(
+                "gg:latest", [peer.url], mesh=mesh8, source="ollama")
+    assert set(placed.arrays) == set(tensors)
+    for name, src in tensors.items():
+        got = np.asarray(placed.arrays[name]).astype(np.float32)
+        assert got.shape == src.shape
+        assert np.allclose(got, src, atol=0.06)
+    # header + tensor ranges cross the wire; alignment padding never does
+    assert report["network_bytes"] >= len(gguf_blob) * 0.95
+
+
 def test_pod_pull_ici_completion_dp(warm_peer):
     """dp mesh: EVERY tensor replicates, yet each host fetches only ~1/2
     of the bytes — the all-gather over ICI moves the rest. Replicas are
