@@ -337,6 +337,29 @@ def synthesize_manifest(store: Store, model: str, source: str = "hf",
             entry_key, sha = synth_key, linked
         elif status == 200 and store.size(key) > 0:
             entry_key, sha = key, meta.get("sha256", "")
+            if store.is_private(key):
+                # gated-repo entry (auth-scoped): the peer plane refuses
+                # private keys, so a manifest referencing one would 404.
+                # Synthesis is the operator explicitly re-sharing the
+                # model — copy-republish under a public key, re-hashing
+                # against the digest recorded at commit time.
+                entry_key = key_for_uri(f"demodel://synth/{model}/{name}")
+                if not store.has(entry_key):
+                    w = store.begin(entry_key)
+                    try:
+                        for chunk in store.stream(key):
+                            w.append(chunk)
+                        if sha and w.digest() != sha:
+                            w.abort(keep_partial=False)
+                            raise IOError(
+                                f"cached {name} does not match its "
+                                "recorded digest")
+                        w.commit({"uri": uri, "sha256": sha or w.digest(),
+                                  "synthesized": True})
+                    except BaseException:
+                        if w._open:  # noqa: SLF001 — writer state check
+                            w.abort(keep_partial=False)
+                        raise
         else:
             continue
         files.setdefault(name, {

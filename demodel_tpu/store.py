@@ -246,6 +246,14 @@ class Store:
     def evictions_total(self) -> int:
         return self._lib.dm_store_evictions(self._h)
 
+    def is_private(self, key: str) -> bool:
+        """True when the entry is auth-scoped (cached for a credentialed
+        request): never advertised on /peer, refused by the peer object
+        server — same rule the native plane applies (store.cc
+        meta_is_private)."""
+        meta = self.meta(key) or {}
+        return bool(meta.get("auth_scope"))
+
     def pin(self, key: str) -> None:
         """Shield ``key`` from :meth:`gc` eviction (process-local). The
         restore registry pins every blob it advertises — evicting one

@@ -276,6 +276,34 @@ def test_synthesized_manifest_from_proxy_warmed_cache(tmp_path, mesh8,
                     np.asarray(placed.arrays[name]), want)
 
 
+def test_synthesis_republishes_gated_entries(tmp_path):
+    """A gated-repo (auth-scoped, private) cache entry cannot be served
+    by the peer plane; operator-invoked synthesis copy-republishes it
+    under a public key with digest verification."""
+    import hashlib
+
+    from demodel_tpu.delivery import synthesize_manifest
+    from demodel_tpu.store import Store
+
+    body = st.serialize({"w": np.ones((8, 8), np.float32)})
+    uri = "https://hub/org/gated/resolve/main/model.safetensors"
+    s = Store(tmp_path / "store")
+    try:
+        s.put("gatedentry000001", body, {
+            "uri": uri, "status": 200, "auth_scope": "deadbeef00000000",
+            "sha256": hashlib.sha256(body).hexdigest(),
+        })
+        assert s.is_private("gatedentry000001")
+        record = synthesize_manifest(s, "org/gated")
+        (entry,) = record["files"]
+        assert entry["name"] == "model.safetensors"
+        assert entry["key"] != "gatedentry000001"
+        assert not s.is_private(entry["key"])  # peer-servable now
+        assert s.get(entry["key"]) == body
+    finally:
+        s.close()
+
+
 def test_pod_pull_15_shard_stream(tmp_path):
     """BASELINE config 5 shape: a 15-shard safetensors checkpoint
     (the Llama-2-70B layout) streamed across pod hosts — each host's
