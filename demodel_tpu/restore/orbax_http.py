@@ -158,19 +158,19 @@ class HTTPRestoreCheckpointHandler(ocp.CheckpointHandler):
                      cast_to, data_base: str | None = None) -> jax.Array:
         shape = tuple(info["shape"])
         np_dtype = _np_dtype(info["dtype"])
-        url = f"{data_base or self.endpoint}/restore/{model}/tensor/{name}"
+        base = (data_base or self.endpoint).rstrip("/")
+        # the window reader fans large shard reads out over native range
+        # streams (socket bytes land in the device_put buffer) and falls
+        # back to single ranged GETs for small windows / https endpoints
+        from demodel_tpu.sink.remote import PeerBlobReader
 
-        def read_at(off, ln):
-            rr = self._session.get(
-                url, headers={"Range": f"bytes={off}-{off + ln - 1}"},
-                timeout=self.timeout)
-            rr.raise_for_status()
-            if len(rr.content) != ln:
-                raise IOError(f"short range read for {name}: "
-                              f"{len(rr.content)} != {ln}")
-            return rr.content
-
-        return place_tensor(read_at, shape, np_dtype, 0, sharding, cast_to)
+        reader = PeerBlobReader(
+            base, name, int(info["nbytes"]),
+            path=f"/restore/{model}/tensor/{name}", timeout=self.timeout)
+        read_at = lambda off, ln: reader.pread(name, ln, off)  # noqa: E731
+        read_into = lambda off, out: reader.pread_into(name, out, off)  # noqa: E731
+        return place_tensor(read_at, shape, np_dtype, 0, sharding, cast_to,
+                            read_into=read_into)
 
     def restore(self, directory=None, args: HTTPRestoreArgs | None = None):
         if args is None:

@@ -63,9 +63,14 @@ class PeerBlobReader:
 
     def __init__(self, peer: str, remote_key: str, size: int,
                  session: requests.Session | None = None,
-                 streams: int | None = None, timeout: float = 120.0):
+                 streams: int | None = None, timeout: float = 120.0,
+                 path: str | None = None):
         self.peer = peer.rstrip("/")
         self.remote_key = remote_key
+        #: served resource path — /peer/object/{key} by default; the
+        #: restore client points this at /restore/{model}/tensor/{name}
+        #: (same Range semantics on the native plane)
+        self.path = path or f"/peer/object/{remote_key}"
         self._size = int(size)
         self.timeout = timeout
         self.streams = streams if streams is not None else env_int(
@@ -116,7 +121,7 @@ class PeerBlobReader:
         errbuf = ctypes.create_string_buffer(512)
         n = native.lib().dm_peer_fetch_window(
             self._native_host.encode(), self._native_port,
-            f"/peer/object/{self.remote_key}".encode(),
+            self.path.encode(),
             offset, length, self._size, self.streams,
             arr.ctypes.data_as(ctypes.c_void_p), errbuf, 512)
         if n != length:
@@ -131,7 +136,7 @@ class PeerBlobReader:
         s = getattr(self._tls, "session", None) or self._session
         if s is None:
             s = self._tls.session = requests.Session()
-        r = s.get(f"{self.peer}/peer/object/{self.remote_key}",
+        r = s.get(f"{self.peer}{self.path}",
                   headers={"Range": f"bytes={offset}-{offset + length - 1}"},
                   stream=True, timeout=self.timeout)
         r.raise_for_status()
