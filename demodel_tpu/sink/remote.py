@@ -249,15 +249,46 @@ def pull_manifest_to_hbm(
     Weight files deliver in manifest order (identical on every host), so
     cross-host collectives pair deterministically — see module docstring.
     """
-    import jax
+    import os
 
     from demodel_tpu.parallel.mesh import make_mesh
-    from demodel_tpu.sink.hbm import deliver_safetensors
 
     if mesh is None:
         mesh = make_mesh()
     if plan is None:
         plan = ShardingPlan(mesh)
+    profile_dir = os.environ.get("DEMODEL_PROFILE_DIR", "").strip()
+    profiling = False
+    if profile_dir:
+        # SURVEY §5 tracing: same jax.profiler window the whole-file pull
+        # gets — open in xprof to see window fetch vs device transfer
+        try:
+            import jax.profiler as _profiler
+
+            _profiler.start_trace(profile_dir)
+            profiling = True
+        except Exception as e:  # noqa: BLE001 — tracing must never break a pull
+            log.warning("jax.profiler trace not started: %s", e)
+    try:
+        return _pull_manifest_to_hbm(model, peers, mesh, plan, source,
+                                     cast_to, ici_complete, streams)
+    finally:
+        if profiling:
+            try:
+                import jax.profiler as _profiler
+
+                _profiler.stop_trace()
+                log.info("sharded-pull trace written to %s", profile_dir)
+            except Exception as e:  # noqa: BLE001
+                log.warning("jax.profiler stop_trace failed: %s", e)
+
+
+def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
+                          ici_complete, streams):
+    import jax
+
+    from demodel_tpu.sink.hbm import deliver_safetensors
+
     t0 = time.perf_counter()
     peer, manifest = fetch_manifest(peers, model, source=source)
     placement = Placement(mesh_desc=f"{dict(mesh.shape)}")
