@@ -61,33 +61,31 @@ def restore(
     fetched_lock = threading.Lock()
     # bytes ride the native data plane when the node advertises one
     data_base = manifest.get("data_endpoint", endpoint).rstrip("/")
-    tls = threading.local()
-
-    def _session():
-        sess = getattr(tls, "s", None)
-        if sess is None:
-            sess = tls.s = requests.Session()
-        return sess
 
     def restore_one(item):
         name, info = item
         shape = tuple(info["shape"])
         np_dtype = _np_dtype(info["dtype"])
         sharding = plan.sharding_for(name, shape, np_dtype.itemsize)
-        url = f"{data_base}/restore/{model}/tensor/{name}"
+        # large shard windows ride the native multi-stream fan-out
+        # (straight into the device_put buffer); small ones a ranged GET
+        from demodel_tpu.sink.remote import PeerBlobReader
 
-        def read_at(off, ln):
+        reader = PeerBlobReader(
+            data_base, name, int(info["nbytes"]),
+            path=f"/restore/{model}/tensor/{name}", timeout=timeout)
+
+        def done() -> None:
             nonlocal fetched
-            rr = _session().get(
-                url, headers={"Range": f"bytes={off}-{off + ln - 1}"},
-                timeout=timeout)
-            rr.raise_for_status()
             with fetched_lock:
-                fetched += len(rr.content)
-            return rr.content
+                fetched += reader.bytes_fetched
 
-        return name, place_tensor(read_at, shape, np_dtype, 0, sharding,
-                                  cast_to)
+        read_at = lambda off, ln: reader.pread(name, ln, off)  # noqa: E731
+        read_into = lambda off, out: reader.pread_into(name, out, off)  # noqa: E731
+        arr = place_tensor(read_at, shape, np_dtype, 0, sharding, cast_to,
+                           read_into=read_into)
+        done()
+        return name, arr
 
     # tensor-level fan-out: a restore is many independent range reads; a
     # small pool hides HTTP latency (device_put is thread-safe)
