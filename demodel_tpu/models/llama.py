@@ -154,6 +154,18 @@ def _rope(x, positions, theta: float):
 # ----------------------------------------------------------------- forward
 
 
+def _use_flash() -> bool:
+    """DEMODEL_FLASH_ATTN=1 routes full-sequence attention through the
+    fused pallas kernel (default off: the einsum path lets XLA fuse
+    freely at short sequence; flash wins once the score tensor dominates
+    HBM). Cached decode keeps the einsum path — its validity window is
+    dynamic (cache_pos), which the static kernel does not model."""
+    import os
+
+    return os.environ.get("DEMODEL_FLASH_ATTN", "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
 def _attn(layer, x, cfg: LlamaConfig, positions, mesh: Mesh | None,
           kv_cache=None, cache_pos=None):
     B, T, D = x.shape
@@ -184,6 +196,13 @@ def _attn(layer, x, cfg: LlamaConfig, positions, mesh: Mesh | None,
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
     elif mesh is not None and int(mesh.shape.get("sp", 1)) > 1:
         out = ring_attention_sharded(q, k, v, mesh, causal=True)
+    elif _use_flash():
+        # fused pallas path: no (B,H,T,T) score tensor in HBM, no
+        # materialized GQA repeat (ops/flash_attention.py); backward
+        # recomputes the reference, so training still differentiates
+        from demodel_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, True)
     else:
         out = dense_attention(q, k, v, causal=True)
     out = out.reshape(B, T, H * hd) @ layer["o_proj"]

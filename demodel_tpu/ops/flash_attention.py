@@ -1,0 +1,198 @@
+"""Fused flash attention — pallas TPU kernel for the model hot path.
+
+The einsum attention in :mod:`demodel_tpu.models.llama` materializes the
+(B, H, S, S) score tensor in HBM; at long sequence that tensor IS the
+memory bill (32k² × heads ≫ the weights). This kernel streams K/V blocks
+through VMEM against resident Q blocks with the online-softmax
+accumulator, so HBM traffic is O(S·D) per head and the MXU sees big
+(block_q × D) × (D × block_k) matmuls:
+
+- grid ``(B, H, Sq/block_q, Sk/block_k)`` — the K dimension iterates
+  minor-most, which on TPU is sequential per core, so the fp32
+  accumulators (m, l, acc) live in VMEM scratch across K steps;
+- GQA folded into the BlockSpec index map (`kv_head = h // q_per_kv`) —
+  no materialized head repeat;
+- causal blocks above the diagonal are skipped entirely (``pl.when``),
+  halving the work for autoregressive shapes;
+- lengths that don't divide the blocks are zero-padded and masked with a
+  key-validity test, so any (Sq, Sk) works.
+
+Backward: ``jax.custom_vjp`` recomputes the reference attention for
+gradients (flash-speed forward, standard-memory backward) — training
+still differentiates end-to-end, and inference/serving (the delivery
+framework's consumer) pays no backward at all.
+
+Ring/context-parallel attention over a mesh axis stays in
+:mod:`demodel_tpu.ops.ring_attention`; this kernel is the per-shard
+inner attention.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------- reference
+
+
+def reference_attention(q, k, v, causal: bool = True, scale=None):
+    """Plain einsum attention (GQA-aware) — the numerics oracle and the
+    recompute backward. q: (B, Sq, H, D); k/v: (B, Sk, G, D), G | H."""
+    B, Sq, H, D = q.shape
+    G = k.shape[2]
+    if G != H:
+        rep = H // G
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if scale is None:
+        scale = D ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Sk = k.shape[1]
+        qi = jnp.arange(Sq)[:, None] + (Sk - Sq)
+        ki = jnp.arange(Sk)[None, :]
+        scores = jnp.where(ki <= qi, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+
+
+# ----------------------------------------------------------------- kernel
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale, causal, block_q, block_k, sk_actual, sq_actual,
+                  offset):
+    """One (b, h, qi, ki) step. Scratch (acc, m, l) persists across the
+    minor-most ki dimension; init at ki==0, finalize at the last ki."""
+    ki = pl.program_id(3)
+    qi = pl.program_id(2)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: a K block strictly above the diagonal contributes nothing.
+    # `offset` aligns query row i with key row i+offset (decode windows).
+    first_masked_k = (qi + 1) * block_q + offset
+    live = jnp.logical_not(causal) | (ki * block_k < first_masked_k)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
+        k = k_ref[0, :, 0, :].astype(jnp.float32)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = q @ k.T  # (block_q, block_k) on the MXU
+
+        q_idx = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_idx = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_idx < sk_actual  # zero-padded keys never score
+        if causal:
+            mask &= k_idx <= q_idx + offset
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_ref[:, 0] = l_ref[:, 0] * alpha + p.sum(axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + p @ v
+        m_ref[:, 0] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        # fully-masked rows (past-Sq padding) have l == 0 — emit zeros
+        l = l_ref[:, 0]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, 0, :] = (acc_ref[...] / safe[:, None]).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis: int, multiple: int):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k):
+    B, Sq, H, D = q.shape
+    Sk, G = k.shape[1], k.shape[2]
+    if H % G != 0:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {G}")
+    q_per_kv = H // G
+    if scale is None:
+        scale = D ** -0.5
+    block_q = min(block_q, max(Sq, 1))
+    block_k = min(block_k, max(Sk, 1))
+    qp = _pad_to(q, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, sk_actual=Sk, sq_actual=Sq,
+            offset=Sk - Sq),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, D),
+                         lambda b, h, qi, ki: (b, qi, h, 0)),
+            pl.BlockSpec((1, block_k, 1, D),
+                         lambda b, h, qi, ki: (b, ki, h // q_per_kv, 0)),
+            pl.BlockSpec((1, block_k, 1, D),
+                         lambda b, h, qi, ki: (b, ki, h // q_per_kv, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, D),
+                               lambda b, h, qi, ki: (b, qi, h, 0)),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),   # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom l
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return out[:, :Sq]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, scale=None,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused attention. q: (B, Sq, H, D); k/v: (B, Sk, G, D) with G | H
+    (GQA). Returns (B, Sq, H, D) in q's dtype. Causal masking aligns the
+    LAST query with the last key (decode-window convention)."""
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
