@@ -155,11 +155,11 @@ def _rope(x, positions, theta: float):
 
 
 def _use_flash() -> bool:
-    """DEMODEL_FLASH_ATTN=1 routes full-sequence attention through the
-    fused pallas kernel (default off: the einsum path lets XLA fuse
-    freely at short sequence; flash wins once the score tensor dominates
-    HBM). Cached decode keeps the einsum path — its validity window is
-    dynamic (cache_pos), which the static kernel does not model."""
+    """DEMODEL_FLASH_ATTN=1 routes attention through the fused pallas
+    kernel (default off: the einsum path lets XLA fuse freely at short
+    sequence; flash wins once the score tensor — or the GQA-repeated KV
+    cache — dominates HBM). Cached decode passes the filled prefix as
+    the kernel's dynamic ``kv_len``."""
     import os
 
     return os.environ.get("DEMODEL_FLASH_ATTN", "").strip().lower() in (
@@ -183,17 +183,27 @@ def _attn(layer, x, cfg: LlamaConfig, positions, mesh: Mesh | None,
         ck = lax.dynamic_update_slice(ck, k, (0, cache_pos, 0, 0))
         cv = lax.dynamic_update_slice(cv, v, (0, cache_pos, 0, 0))
         new_cache = (ck, cv)
-        S = ck.shape[1]
-        rep = H // Hkv
-        kk = jnp.repeat(ck, rep, axis=2)
-        vv = jnp.repeat(cv, rep, axis=2)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * hd ** -0.5
-        kpos = jnp.arange(S)
-        qpos = cache_pos + jnp.arange(T)
-        mask = kpos[None, :] <= qpos[:, None]
-        scores = jnp.where(mask[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+        if _use_flash():
+            # fused decode: no repeat of the whole cache across query
+            # heads, and K blocks past the filled prefix are skipped —
+            # cost scales with cache_pos + T, not the cache capacity
+            from demodel_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, ck, cv, kv_len=cache_pos + T,
+                                  causal=True)
+        else:
+            S = ck.shape[1]
+            rep = H // Hkv
+            kk = jnp.repeat(ck, rep, axis=2)
+            vv = jnp.repeat(cv, rep, axis=2)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * hd ** -0.5
+            kpos = jnp.arange(S)
+            qpos = cache_pos + jnp.arange(T)
+            mask = kpos[None, :] <= qpos[:, None]
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores.astype(jnp.float32),
+                                   axis=-1).astype(q.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
     elif mesh is not None and int(mesh.shape.get("sp", 1)) > 1:
         out = ring_attention_sharded(q, k, v, mesh, causal=True)
     elif _use_flash():
@@ -202,7 +212,7 @@ def _attn(layer, x, cfg: LlamaConfig, positions, mesh: Mesh | None,
         # recomputes the reference, so training still differentiates
         from demodel_tpu.ops.flash_attention import flash_attention
 
-        out = flash_attention(q, k, v, True)
+        out = flash_attention(q, k, v, causal=True)
     else:
         out = dense_attention(q, k, v, causal=True)
     out = out.reshape(B, T, H * hd) @ layer["o_proj"]

@@ -11,11 +11,13 @@ accumulator, so HBM traffic is O(S·D) per head and the MXU sees big
   minor-most, which on TPU is sequential per core, so the fp32
   accumulators (m, l, acc) live in VMEM scratch across K steps;
 - GQA folded into the BlockSpec index map (`kv_head = h // q_per_kv`) —
-  no materialized head repeat;
-- causal blocks above the diagonal are skipped entirely (``pl.when``),
-  halving the work for autoregressive shapes;
-- lengths that don't divide the blocks are zero-padded and masked with a
-  key-validity test, so any (Sq, Sk) works.
+  no materialized head repeat (for a KV cache this is the decode-time
+  memory bill);
+- ``kv_len`` is a DYNAMIC scalar (SMEM operand): K blocks at or past the
+  valid length are skipped entirely (``pl.when``), so decode over a
+  mostly-empty cache costs only the filled prefix;
+- causal blocks above the diagonal are skipped too, halving prefill;
+- lengths that don't divide the blocks are zero-padded and masked.
 
 Backward: ``jax.custom_vjp`` recomputes the reference attention for
 gradients (flash-speed forward, standard-memory backward) — training
@@ -33,6 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -46,23 +49,30 @@ def _interpret() -> bool:
 # ------------------------------------------------------------- reference
 
 
-def reference_attention(q, k, v, causal: bool = True, scale=None):
+def reference_attention(q, k, v, causal: bool = True, scale=None,
+                        kv_len=None):
     """Plain einsum attention (GQA-aware) — the numerics oracle and the
-    recompute backward. q: (B, Sq, H, D); k/v: (B, Sk, G, D), G | H."""
+    recompute backward. q: (B, Sq, H, D); k/v: (B, Sk, G, D), G | H.
+    ``kv_len`` bounds the valid key prefix (defaults to Sk); causal
+    masking aligns the LAST query with key ``kv_len - 1``."""
     B, Sq, H, D = q.shape
-    G = k.shape[2]
+    Sk, G = k.shape[1], k.shape[2]
     if G != H:
         rep = H // G
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     if scale is None:
         scale = D ** -0.5
+    if kv_len is None:
+        kv_len = Sk
+    kv_len = jnp.asarray(kv_len, jnp.int32)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    ki = jnp.arange(Sk)[None, :]
+    mask = ki < kv_len
     if causal:
-        Sk = k.shape[1]
-        qi = jnp.arange(Sq)[:, None] + (Sk - Sq)
-        ki = jnp.arange(Sk)[None, :]
-        scores = jnp.where(ki <= qi, scores, NEG_INF)
+        qi = jnp.arange(Sq)[:, None] + (kv_len - Sq)
+        mask = mask & (ki <= qi)
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
 
@@ -70,9 +80,8 @@ def reference_attention(q, k, v, causal: bool = True, scale=None):
 # ----------------------------------------------------------------- kernel
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale, causal, block_q, block_k, sk_actual, sq_actual,
-                  offset):
+def _flash_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                  l_ref, *, scale, causal, block_q, block_k, sq_actual):
     """One (b, h, qi, ki) step. Scratch (acc, m, l) persists across the
     minor-most ki dimension; init at ki==0, finalize at the last ki."""
     ki = pl.program_id(3)
@@ -85,10 +94,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # causal: a K block strictly above the diagonal contributes nothing.
-    # `offset` aligns query row i with key row i+offset (decode windows).
-    first_masked_k = (qi + 1) * block_q + offset
-    live = jnp.logical_not(causal) | (ki * block_k < first_masked_k)
+    sk_actual = kvlen_ref[0]
+    # aligns query row i with key row i+offset (decode windows)
+    offset = sk_actual - sq_actual
+    # skip K blocks that are entirely invalid (past kv_len) or entirely
+    # above the causal diagonal — decode over a long, mostly-empty cache
+    # then costs only the filled prefix
+    live = ki * block_k < sk_actual
+    if causal:
+        live &= ki * block_k < (qi + 1) * block_q + offset
 
     @pl.when(live)
     def _step():
@@ -101,7 +115,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             jnp.int32, (block_q, block_k), 0)
         k_idx = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        mask = k_idx < sk_actual  # zero-padded keys never score
+        mask = k_idx < sk_actual  # padded / unfilled keys never score
         if causal:
             mask &= k_idx <= q_idx + offset
         s = jnp.where(mask, s, NEG_INF)
@@ -132,7 +146,7 @@ def _pad_to(x, axis: int, multiple: int):
     return jnp.pad(x, widths)
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k):
+def _flash_forward(q, k, v, kv_len, causal, scale, block_q, block_k):
     B, Sq, H, D = q.shape
     Sk, G = k.shape[1], k.shape[2]
     if H % G != 0:
@@ -146,14 +160,17 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
     kp = _pad_to(k, 1, block_k)
     vp = _pad_to(v, 1, block_k)
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+    if kv_len is None:
+        kv_len = Sk
+    kv_arr = jnp.reshape(jnp.asarray(kv_len, jnp.int32), (1,))
 
     out = pl.pallas_call(
         functools.partial(
             _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, sk_actual=Sk, sq_actual=Sq,
-            offset=Sk - Sq),
+            block_k=block_k, sq_actual=Sq),
         grid=(B, H, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, 1, D),
                          lambda b, h, qi, ki: (b, qi, h, 0)),
             pl.BlockSpec((1, block_k, 1, D),
@@ -170,29 +187,37 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
             pltpu.VMEM((block_q, 1), jnp.float32),   # running denom l
         ],
         interpret=_interpret(),
-    )(qp, kp, vp)
+    )(kv_arr, qp, kp, vp)
     return out[:, :Sq]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = True, scale=None,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention(q, k, v, kv_len=None, causal: bool = True, scale=None,
                     block_q: int = 128, block_k: int = 128):
     """Fused attention. q: (B, Sq, H, D); k/v: (B, Sk, G, D) with G | H
-    (GQA). Returns (B, Sq, H, D) in q's dtype. Causal masking aligns the
-    LAST query with the last key (decode-window convention)."""
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k)
+    (GQA). Returns (B, Sq, H, D) in q's dtype. ``kv_len`` (static or
+    traced scalar) bounds the valid key prefix — pass the filled cache
+    length for decode; causal masking aligns the LAST query with key
+    ``kv_len - 1``."""
+    return _flash_forward(q, k, v, kv_len, causal, scale, block_q, block_k)
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+def _fwd(q, k, v, kv_len, causal, scale, block_q, block_k):
+    out = _flash_forward(q, k, v, kv_len, causal, scale, block_q, block_k)
+    return out, (q, k, v, kv_len)
 
 
 def _bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
+    q, k, v, kv_len = res
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal, scale),
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal, scale,
+                                               kv_len=kv_len),
         q, k, v)
-    return vjp(g)
+    dq, dk, dv = vjp(g)
+    # kv_len is integral — its cotangent is the zero-information float0
+    d_len = None if kv_len is None else \
+        np.zeros(jnp.shape(jnp.asarray(kv_len)), jax.dtypes.float0)
+    return dq, dk, dv, d_len
 
 
 flash_attention.defvjp(_fwd, _bwd)

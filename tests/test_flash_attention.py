@@ -21,7 +21,7 @@ def _mk(B, Sq, Sk, H, G, D, dtype=jnp.float32, seed=0):
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_matches_reference(causal):
     q, k, v = _mk(2, 64, 64, 4, 4, 32)
-    got = flash_attention(q, k, v, causal, None, 32, 32)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
     want = reference_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
@@ -30,7 +30,7 @@ def test_flash_matches_reference(causal):
 def test_flash_gqa_heads():
     """8 query heads over 2 kv heads — the index-map fold, no repeat."""
     q, k, v = _mk(1, 32, 32, 8, 2, 16, seed=3)
-    got = flash_attention(q, k, v, True, None, 16, 16)
+    got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
     want = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
@@ -40,7 +40,7 @@ def test_flash_ragged_lengths_padded_and_masked():
     """Sq/Sk not multiples of the blocks: zero-padding must not leak into
     the softmax (key-validity mask) and the output slices back exactly."""
     q, k, v = _mk(2, 48, 80, 4, 4, 32, seed=5)
-    got = flash_attention(q, k, v, False, None, 32, 32)
+    got = flash_attention(q, k, v, causal=False, block_q=32, block_k=32)
     want = reference_attention(q, k, v, causal=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
@@ -50,7 +50,7 @@ def test_flash_decode_window_alignment():
     """Sq < Sk (decode with KV cache): the causal diagonal aligns the
     last query to the last key."""
     q, k, v = _mk(1, 8, 72, 4, 4, 32, seed=7)
-    got = flash_attention(q, k, v, True, None, 8, 24)
+    got = flash_attention(q, k, v, causal=True, block_q=8, block_k=24)
     want = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
@@ -58,12 +58,57 @@ def test_flash_decode_window_alignment():
 
 def test_flash_bf16_io_fp32_accum():
     q, k, v = _mk(1, 64, 64, 2, 2, 64, dtype=jnp.bfloat16, seed=9)
-    got = flash_attention(q, k, v, True, None, 32, 32)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
     assert got.dtype == jnp.bfloat16
     want = reference_attention(q.astype(jnp.float32), k.astype(jnp.float32),
                                v.astype(jnp.float32), causal=True)
     np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
                                np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_flash_dynamic_kv_len():
+    """A traced kv_len (decode over a mostly-empty cache) masks the
+    unfilled tail and aligns the causal window to the filled prefix."""
+    q, k, v = _mk(1, 4, 96, 4, 4, 32, seed=13)
+    filled = 40  # cache capacity 96, only 40 slots valid
+    got = jax.jit(lambda q_, k_, v_, n: flash_attention(
+        q_, k_, v_, kv_len=n, causal=True, block_q=4, block_k=16))(
+            q, k, v, jnp.int32(filled))
+    want = reference_attention(q, k[:, :filled], v[:, :filled], causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_llama_decode_cache_parity_with_flash(monkeypatch):
+    """DEMODEL_FLASH_ATTN=1 on the cached decode path: same logits as
+    the einsum cache attention, step by step."""
+    from demodel_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.key(4), cfg)
+    prompt = jnp.asarray(
+        np.arange(1 * 12, dtype=np.int32).reshape(1, 12) % cfg.vocab_size)
+
+    def decode(n_steps):
+        cache = llama.init_cache(cfg, batch=1, max_len=32)
+        logits, cache = llama.forward_with_cache(params, prompt, cfg,
+                                                 cache, 0)
+        outs = [logits[:, -1:]]
+        pos = prompt.shape[1]
+        tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+        for _ in range(n_steps):
+            logits, cache = llama.forward_with_cache(params, tok, cfg,
+                                                     cache, pos)
+            outs.append(logits[:, -1:])
+            tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+            pos += 1
+        return jnp.concatenate(outs, axis=1)
+
+    base = decode(3)
+    monkeypatch.setenv("DEMODEL_FLASH_ATTN", "1")
+    flash = decode(3)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(base),
+                               rtol=2e-4, atol=2e-4)
 
 
 def test_llama_forward_parity_with_flash(monkeypatch):
@@ -86,7 +131,7 @@ def test_flash_grad_matches_reference():
     q, k, v = _mk(1, 32, 32, 2, 2, 16, seed=11)
 
     def loss_flash(q_, k_, v_):
-        return (flash_attention(q_, k_, v_, True, None, 16, 16) ** 2).sum()
+        return (flash_attention(q_, k_, v_, causal=True, block_q=16, block_k=16) ** 2).sum()
 
     def loss_ref(q_, k_, v_):
         return (reference_attention(q_, k_, v_, causal=True) ** 2).sum()
