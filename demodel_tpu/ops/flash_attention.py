@@ -13,20 +13,21 @@ accumulator, so HBM traffic is O(S·D) per head and the MXU sees big
 - GQA folded into the BlockSpec index map (`kv_head = h // q_per_kv`) —
   no materialized head repeat (for a KV cache this is the decode-time
   memory bill);
-- ``kv_len`` is a DYNAMIC scalar (SMEM operand): K blocks at or past the
-  valid length are skipped entirely (``pl.when``), so decode over a
-  mostly-empty cache costs only the filled prefix;
+- two DYNAMIC scalars ride in SMEM: ``kv_len`` (valid key prefix — K
+  blocks past it are skipped, so decode over a mostly-empty cache costs
+  only the filled prefix) and ``causal_offset`` (which key the last
+  query aligns to — decode windows, and the shifted diagonals of ring
+  attention steps);
 - causal blocks above the diagonal are skipped too, halving prefill;
-- lengths that don't divide the blocks are zero-padded and masked.
+- lengths that don't divide the blocks are zero-padded and masked;
+- the per-row log-sum-exp is emitted alongside the output, which is
+  exactly what :mod:`demodel_tpu.ops.ring_attention` needs to combine
+  per-ring-step partials without ever holding raw score tensors.
 
 Backward: ``jax.custom_vjp`` recomputes the reference attention for
 gradients (flash-speed forward, standard-memory backward) — training
 still differentiates end-to-end, and inference/serving (the delivery
 framework's consumer) pays no backward at all.
-
-Ring/context-parallel attention over a mesh axis stays in
-:mod:`demodel_tpu.ops.ring_attention`; this kernel is the per-shard
-inner attention.
 """
 
 from __future__ import annotations
@@ -49,12 +50,22 @@ def _interpret() -> bool:
 # ------------------------------------------------------------- reference
 
 
-def reference_attention(q, k, v, causal: bool = True, scale=None,
-                        kv_len=None):
-    """Plain einsum attention (GQA-aware) — the numerics oracle and the
-    recompute backward. q: (B, Sq, H, D); k/v: (B, Sk, G, D), G | H.
-    ``kv_len`` bounds the valid key prefix (defaults to Sk); causal
-    masking aligns the LAST query with key ``kv_len - 1``."""
+def _mask(Sq, Sk, kv_len, causal, causal_offset):
+    ki = jnp.arange(Sk)[None, :]
+    qi = jnp.arange(Sq)[:, None]
+    m = ki < kv_len
+    if causal:
+        m = m & (ki <= qi + causal_offset)
+    return m
+
+
+def reference_attention_lse(q, k, v, causal: bool = True, scale=None,
+                            kv_len=None, causal_offset=None):
+    """Einsum attention (GQA-aware) returning ``(out, lse)`` — the
+    numerics oracle and the recompute backward. q: (B, Sq, H, D);
+    k/v: (B, Sk, G, D), G | H. ``kv_len`` bounds the valid key prefix;
+    ``causal_offset`` shifts the diagonal (default aligns the LAST query
+    with key ``kv_len - 1``)."""
     B, Sq, H, D = q.shape
     Sk, G = k.shape[1], k.shape[2]
     if G != H:
@@ -66,22 +77,30 @@ def reference_attention(q, k, v, causal: bool = True, scale=None,
     if kv_len is None:
         kv_len = Sk
     kv_len = jnp.asarray(kv_len, jnp.int32)
+    if causal_offset is None:
+        causal_offset = kv_len - Sq
+    causal_offset = jnp.asarray(causal_offset, jnp.int32)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    ki = jnp.arange(Sk)[None, :]
-    mask = ki < kv_len
-    if causal:
-        qi = jnp.arange(Sq)[:, None] + (kv_len - Sq)
-        mask = mask & (ki <= qi)
+    mask = _mask(Sq, Sk, kv_len, causal, causal_offset)
     scores = jnp.where(mask[None, None], scores, NEG_INF)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)  # (B, H, Sq)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+    return out, lse.transpose(0, 2, 1)  # lse → (B, Sq, H)
+
+
+def reference_attention(q, k, v, causal: bool = True, scale=None,
+                        kv_len=None, causal_offset=None):
+    return reference_attention_lse(q, k, v, causal, scale, kv_len,
+                                   causal_offset)[0]
 
 
 # ----------------------------------------------------------------- kernel
 
 
-def _flash_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
-                  l_ref, *, scale, causal, block_q, block_k, sq_actual):
+def _flash_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  acc_ref, m_ref, l_ref, *, scale, causal, block_q,
+                  block_k):
     """One (b, h, qi, ki) step. Scratch (acc, m, l) persists across the
     minor-most ki dimension; init at ki==0, finalize at the last ki."""
     ki = pl.program_id(3)
@@ -94,9 +113,8 @@ def _flash_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    sk_actual = kvlen_ref[0]
-    # aligns query row i with key row i+offset (decode windows)
-    offset = sk_actual - sq_actual
+    sk_actual = scalars_ref[0]
+    offset = scalars_ref[1]
     # skip K blocks that are entirely invalid (past kv_len) or entirely
     # above the causal diagonal — decode over a long, mostly-empty cache
     # then costs only the filled prefix
@@ -130,10 +148,14 @@ def _flash_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        # fully-masked rows (past-Sq padding) have l == 0 — emit zeros
+        # fully-masked rows (past-Sq padding / no visible keys) have
+        # l == 0 — emit zeros and an lse of NEG_INF (combines as "no
+        # contribution" in the ring's log-space merge)
         l = l_ref[:, 0]
         safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, :, 0, :] = (acc_ref[...] / safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, :, 0] = jnp.where(
+            l > 0.0, m_ref[:, 0] + jnp.log(safe), NEG_INF)
 
 
 def _pad_to(x, axis: int, multiple: int):
@@ -146,7 +168,8 @@ def _pad_to(x, axis: int, multiple: int):
     return jnp.pad(x, widths)
 
 
-def _flash_forward(q, k, v, kv_len, causal, scale, block_q, block_k):
+def _flash_forward(q, k, v, kv_len, causal_offset, causal, scale, block_q,
+                   block_k):
     B, Sq, H, D = q.shape
     Sk, G = k.shape[1], k.shape[2]
     if H % G != 0:
@@ -162,12 +185,16 @@ def _flash_forward(q, k, v, kv_len, causal, scale, block_q, block_k):
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
     if kv_len is None:
         kv_len = Sk
-    kv_arr = jnp.reshape(jnp.asarray(kv_len, jnp.int32), (1,))
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    if causal_offset is None:
+        causal_offset = kv_len - Sq
+    scalars = jnp.stack([kv_len,
+                         jnp.asarray(causal_offset, jnp.int32)])
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, sq_actual=Sq),
+            block_k=block_k),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -178,46 +205,68 @@ def _flash_forward(q, k, v, kv_len, causal, scale, block_q, block_k):
             pl.BlockSpec((1, block_k, 1, D),
                          lambda b, h, qi, ki: (b, ki, h // q_per_kv, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, 1, D),
-                               lambda b, h, qi, ki: (b, qi, h, 0)),
-        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, 1, D),
+                         lambda b, h, qi, ki: (b, qi, h, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, h, qi, ki: (b, qi, h)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, qp.shape[1], H), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),   # acc
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
             pltpu.VMEM((block_q, 1), jnp.float32),   # running denom l
         ],
         interpret=_interpret(),
-    )(kv_arr, qp, kp, vp)
-    return out[:, :Sq]
+    )(scalars, qp, kp, vp)
+    return out[:, :Sq], lse[:, :Sq]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def flash_attention(q, k, v, kv_len=None, causal: bool = True, scale=None,
-                    block_q: int = 128, block_k: int = 128):
-    """Fused attention. q: (B, Sq, H, D); k/v: (B, Sk, G, D) with G | H
-    (GQA). Returns (B, Sq, H, D) in q's dtype. ``kv_len`` (static or
-    traced scalar) bounds the valid key prefix — pass the filled cache
-    length for decode; causal masking aligns the LAST query with key
-    ``kv_len - 1``."""
-    return _flash_forward(q, k, v, kv_len, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_core(q, k, v, kv_len, causal_offset, causal, scale, block_q,
+                block_k):
+    return _flash_forward(q, k, v, kv_len, causal_offset, causal, scale,
+                          block_q, block_k)
 
 
-def _fwd(q, k, v, kv_len, causal, scale, block_q, block_k):
-    out = _flash_forward(q, k, v, kv_len, causal, scale, block_q, block_k)
-    return out, (q, k, v, kv_len)
+def _fwd(q, k, v, kv_len, causal_offset, causal, scale, block_q, block_k):
+    out = _flash_forward(q, k, v, kv_len, causal_offset, causal, scale,
+                         block_q, block_k)
+    return out, (q, k, v, kv_len, causal_offset)
 
 
 def _bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v, kv_len = res
+    q, k, v, kv_len, causal_offset = res
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal, scale,
-                                               kv_len=kv_len),
+        lambda q_, k_, v_: reference_attention_lse(
+            q_, k_, v_, causal, scale, kv_len=kv_len,
+            causal_offset=causal_offset),
         q, k, v)
     dq, dk, dv = vjp(g)
-    # kv_len is integral — its cotangent is the zero-information float0
-    d_len = None if kv_len is None else \
-        np.zeros(jnp.shape(jnp.asarray(kv_len)), jax.dtypes.float0)
-    return dq, dk, dv, d_len
+
+    def _zero_int(x):
+        return None if x is None else \
+            np.zeros(jnp.shape(jnp.asarray(x)), jax.dtypes.float0)
+
+    return dq, dk, dv, _zero_int(kv_len), _zero_int(causal_offset)
 
 
-flash_attention.defvjp(_fwd, _bwd)
+_flash_core.defvjp(_fwd, _bwd)
+
+
+def flash_attention(q, k, v, kv_len=None, causal: bool = True, scale=None,
+                    block_q: int = 128, block_k: int = 128,
+                    causal_offset=None, return_lse: bool = False):
+    """Fused attention. q: (B, Sq, H, D); k/v: (B, Sk, G, D) with G | H
+    (GQA). Returns (B, Sq, H, D) in q's dtype (plus the per-row
+    log-sum-exp, (B, Sq, H) f32, when ``return_lse``). ``kv_len``
+    (static or traced) bounds the valid key prefix — pass the filled
+    cache length for decode. ``causal_offset`` shifts the diagonal
+    (query i sees keys ≤ i+offset); it defaults to ``kv_len - Sq``,
+    aligning the LAST query with the last valid key."""
+    out, lse = _flash_core(q, k, v, kv_len, causal_offset, causal, scale,
+                           block_q, block_k)
+    return (out, lse) if return_lse else out

@@ -48,15 +48,71 @@ def dense_attention(q, k, v, causal: bool = True,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _use_flash_ring() -> bool:
+    """DEMODEL_FLASH_RING=1 computes each ring step with the fused pallas
+    kernel (ops/flash_attention.py) and combines the per-step partials in
+    log space — no (B,H,Tq,Tk) score tensor per step, and no GQA head
+    repeat riding the ppermute."""
+    import os
+
+    return os.environ.get("DEMODEL_FLASH_RING", "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal, scale, kv_len):
+    """Flash-tiled ring: per step, the kernel returns the NORMALIZED
+    partial and its per-row logsumexp; partials merge as
+    ``O ← O·e^{L−L'} + O_i·e^{L_i−L'}`` with ``L' = logaddexp(L, L_i)``
+    — numerically the same online softmax the einsum path runs, held at
+    row granularity instead of materialized scores."""
+    from demodel_tpu.ops.flash_attention import flash_attention
+
+    B, Tq, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    Tk = k.shape[1]
+
+    O = jnp.zeros((B, Tq, H, D), jnp.float32)
+    L = jnp.full((B, Tq, H), NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        src = (my - step) % n
+        # absolute-position masking folded into the kernel's scalars:
+        # query i (global my·Tq+i) sees key j (global src·Tk+j) iff
+        # j ≤ i + (my·Tq − src·Tk); ring padding is key-validity
+        offset_step = my * Tq - src * Tk
+        kv_local = Tk if kv_len is None else jnp.clip(
+            kv_len - src * Tk, 0, Tk)
+        out_i, lse_i = flash_attention(
+            q, k, v, kv_len=kv_local, causal=causal, scale=scale,
+            causal_offset=offset_step, return_lse=True)
+        L_comb = jnp.logaddexp(L, lse_i)
+        O = (O * jnp.exp(L - L_comb)[..., None]
+             + out_i.astype(jnp.float32) * jnp.exp(lse_i - L_comb)[..., None])
+        L = L_comb
+        if step != n - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    return O.astype(q.dtype)
+
+
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
                    scale: float | None = None,
-                   kv_len: jax.Array | None = None) -> jax.Array:
+                   kv_len: jax.Array | None = None,
+                   use_flash: bool | None = None) -> jax.Array:
     """Per-shard ring attention (call inside shard_map over ``axis_name``).
 
     q: [B, T_loc, H, D]; k/v: [B, T_loc, Hkv, D] (GQA repeats on the fly).
     ``kv_len`` (global) masks ring padding when the true sequence length is
     not a multiple of the ring size.
     """
+    if use_flash is None:
+        use_flash = _use_flash_ring()
+    if use_flash:
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale,
+                                     kv_len)
     B, Tq, H, D = q.shape
     Hkv = k.shape[2]
     if H != Hkv:
