@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from demodel_tpu.models.common import layer_norm
+from demodel_tpu.models.common import layer_norm, use_flash_attention as _use_flash
 
 
 @dataclass(frozen=True)
@@ -142,10 +142,18 @@ def encode(params, tokens, cfg: BertConfig, attention_mask=None,
         q = (x @ layer["q"]["w"] + layer["q"]["b"]).reshape(B, T, H, hd)
         k = (x @ layer["k"]["w"] + layer["k"]["b"]).reshape(B, T, H, hd)
         v = (x @ layer["v"]["w"] + layer["v"]["b"]).reshape(B, T, H, hd)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd ** -0.5
-        scores = scores.astype(jnp.float32) + bias
-        probs = jax.nn.softmax(scores, -1).astype(x.dtype)
-        a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, -1)
+        if attention_mask is None and _use_flash():
+            # bidirectional full-length attention maps to the fused
+            # kernel directly; per-example masks keep the einsum path
+            # (they need per-batch validity the kernel does not model)
+            from demodel_tpu.ops.flash_attention import flash_attention
+
+            a = flash_attention(q, k, v, causal=False).reshape(B, T, -1)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd ** -0.5
+            scores = scores.astype(jnp.float32) + bias
+            probs = jax.nn.softmax(scores, -1).astype(x.dtype)
+            a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, -1)
         a = a @ layer["attn_out"]["w"] + layer["attn_out"]["b"]
         x = layer_norm(x + a, layer["attn_ln"]["w"], layer["attn_ln"]["b"],
                        eps)

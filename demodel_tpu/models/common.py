@@ -24,3 +24,14 @@ def layer_norm(x, weight, bias, eps: float = 1e-5):
     var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
     y = (xf - mu) * lax.rsqrt(var + eps)
     return y.astype(x.dtype) * weight + bias
+
+
+def use_flash_attention() -> bool:
+    """DEMODEL_FLASH_ATTN=1 routes model attention through the fused
+    pallas kernel (ops/flash_attention.py). Default off: the einsum path
+    lets XLA fuse freely at short sequence; flash wins once the score
+    tensor — or the GQA-repeated KV cache — dominates HBM."""
+    import os
+
+    return os.environ.get("DEMODEL_FLASH_ATTN", "").strip().lower() in (
+        "1", "true", "yes", "on")

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from demodel_tpu.models.common import layer_norm
+from demodel_tpu.models.common import layer_norm, use_flash_attention as _use_flash
 
 
 @dataclass(frozen=True)
@@ -123,10 +123,16 @@ def forward(params, tokens, cfg: GPT2Config, mesh: Mesh | None = None):
         q = q.reshape(B, T, H, hd)
         k = k.reshape(B, T, H, hd)
         v = v.reshape(B, T, H, hd)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd ** -0.5
-        scores = jnp.where(mask[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(x.dtype)
-        a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, -1)
+        if _use_flash():
+            from demodel_tpu.ops.flash_attention import flash_attention
+
+            a = flash_attention(q, k, v, causal=True).reshape(B, T, -1)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd ** -0.5
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores.astype(jnp.float32),
+                                   -1).astype(x.dtype)
+            a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, -1)
         x = x + (a @ layer["c_proj"]["w"] + layer["c_proj"]["b"])
         h = layer_norm(x, layer["ln_2"]["w"], layer["ln_2"]["b"], eps)
         h = jax.nn.gelu(h @ layer["mlp_fc"]["w"] + layer["mlp_fc"]["b"],

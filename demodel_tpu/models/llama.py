@@ -21,7 +21,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from demodel_tpu.models.common import rms_norm
+from demodel_tpu.models.common import rms_norm, use_flash_attention as _use_flash
 from demodel_tpu.ops.ring_attention import (
     dense_attention,
     ring_attention_sharded,
@@ -152,18 +152,6 @@ def _rope(x, positions, theta: float):
 
 
 # ----------------------------------------------------------------- forward
-
-
-def _use_flash() -> bool:
-    """DEMODEL_FLASH_ATTN=1 routes attention through the fused pallas
-    kernel (default off: the einsum path lets XLA fuse freely at short
-    sequence; flash wins once the score tensor — or the GQA-repeated KV
-    cache — dominates HBM). Cached decode passes the filled prefix as
-    the kernel's dynamic ``kv_len``."""
-    import os
-
-    return os.environ.get("DEMODEL_FLASH_ATTN", "").strip().lower() in (
-        "1", "true", "yes", "on")
 
 
 def _attn(layer, x, cfg: LlamaConfig, positions, mesh: Mesh | None,

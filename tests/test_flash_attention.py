@@ -126,6 +126,31 @@ def test_llama_forward_parity_with_flash(monkeypatch):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_gpt2_bert_forward_parity_with_flash(monkeypatch):
+    """The same flag routes GPT-2 (causal) and BERT (bidirectional,
+    unmasked) attention through the kernel without numeric drift."""
+    from demodel_tpu.models import bert, gpt2
+
+    gcfg = gpt2.GPT2Config.tiny()
+    gparams = gpt2.init_params(jax.random.key(1), gcfg)
+    gtok = jnp.asarray(
+        np.arange(2 * 20, dtype=np.int32).reshape(2, 20) % gcfg.vocab_size)
+    bcfg = bert.BertConfig.tiny()
+    bparams = bert.init_params(jax.random.key(2), bcfg)
+    btok = jnp.asarray(
+        np.arange(2 * 16, dtype=np.int32).reshape(2, 16) % bcfg.vocab_size)
+
+    gbase = gpt2.forward(gparams, gtok, gcfg)
+    bbase = bert.encode(bparams, btok, bcfg)
+    monkeypatch.setenv("DEMODEL_FLASH_ATTN", "1")
+    gflash = gpt2.forward(gparams, gtok, gcfg)
+    bflash = bert.encode(bparams, btok, bcfg)
+    np.testing.assert_allclose(np.asarray(gflash), np.asarray(gbase),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(bflash), np.asarray(bbase),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_flash_grad_matches_reference():
     """custom_vjp recompute backward: grads equal the reference's."""
     q, k, v = _mk(1, 32, 32, 2, 2, 16, seed=11)
