@@ -13,6 +13,13 @@ import jax.numpy as jnp
 from demodel_tpu.formats import gguf
 from demodel_tpu.ops import dequant as dq
 
+@pytest.fixture(autouse=True)
+def _force_pallas(monkeypatch):
+    """These are the KERNEL tests: pin the pallas path (interpret mode on
+    CPU) even though off-TPU delivery takes the vectorized math path."""
+    monkeypatch.setenv("DEMODEL_FORCE_PALLAS", "1")
+
+
 _FNS = {
     gguf.GGML_Q8_0: dq.dequant_q8_0,
     gguf.GGML_Q4_0: dq.dequant_q4_0,
