@@ -183,37 +183,64 @@ def fetch_manifest(peers: list[str], model: str, source: str = "hf",
                   + (f" (last error: {last_err})" if last_err else ""))
 
 
-def _deliver_pipelined(reader: PeerBlobReader, key: str, mesh, plan,
-                       cast_to=None) -> Placement:
-    """Single-process safetensors delivery with a 1-deep tensor prefetch:
-    tensor N+1's byte window downloads (multi-stream, native) while tensor
-    N's ``device_put`` is in flight — wall-clock ≈ max(network, host→HBM)
-    instead of their sum. Only used when this process addresses the whole
-    mesh (a pod host must fetch exactly its shard windows instead —
-    prefetching whole tensors would defeat shard reads)."""
+def _reader_and_index(f: dict, peer_order: list[str], streams):
+    """Open ``f`` on the first peer that can serve its safetensors index
+    (header reads fail over; window reads during delivery are handled by
+    the caller's retry policy)."""
+    from demodel_tpu.formats import safetensors as st
+
+    last_err: Exception | None = None
+    for source_peer in peer_order:
+        reader = PeerBlobReader(source_peer, f["key"], int(f["size"]),
+                                streams=streams)
+        try:
+            index = st.read_index_from(
+                lambda off, ln: reader.pread(f["key"], ln, off),
+                total_size=reader.size(f["key"]))
+            return reader, index
+        except OSError as e:
+            last_err = e
+            log.warning("index of %s from %s failed (%s); trying next "
+                        "peer", f["name"], source_peer, e)
+    raise IOError(f"no peer could serve {f['name']}") from last_err
+
+
+def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
+                            prefetch_depth: int | None = None) -> Placement:
+    """Single-process safetensors delivery with a tensor prefetch window
+    spanning FILE boundaries: while tensor N's ``device_put`` is in
+    flight, the next ``prefetch_depth`` tensors' byte windows download
+    (multi-stream, native) — wall-clock ≈ max(network, host→HBM) instead
+    of their sum, with no bubble between files. Only used when this
+    process addresses the whole mesh (a pod host must fetch exactly its
+    shard windows instead — prefetching whole tensors would defeat shard
+    reads).
+
+    ``jobs``: ``[(reader, key, name, spec)]`` in manifest order.
+    """
     from concurrent.futures import ThreadPoolExecutor
 
-    from demodel_tpu.formats import safetensors as st
     from demodel_tpu.formats.safetensors import _np_dtype
     from demodel_tpu.sink.hbm import place_tensor
 
-    index = st.read_index_from(
-        lambda off, ln: reader.pread(key, ln, off),
-        total_size=reader.size(key))
-    items = list(index.tensors.items())
+    if prefetch_depth is None:
+        prefetch_depth = env_int("DEMODEL_SINK_PREFETCH", 2, minimum=1)
     out = Placement(mesh_desc=f"{dict(mesh.shape)}")
 
-    def fetch(spec):
+    def fetch(job):
+        reader, key, _name, spec = job
         buf = np.empty(spec.end - spec.start, dtype=np.uint8)
         reader.pread_into(key, buf, spec.start)
         return buf
 
-    with ThreadPoolExecutor(max_workers=1) as ex:
-        nxt = ex.submit(fetch, items[0][1]) if items else None
-        for i, (name, spec) in enumerate(items):
-            buf = nxt.result()
-            if i + 1 < len(items):
-                nxt = ex.submit(fetch, items[i + 1][1])
+    with ThreadPoolExecutor(max_workers=prefetch_depth) as ex:
+        pending = [ex.submit(fetch, j)
+                   for j in jobs[:prefetch_depth]]
+        for i, (reader, key, name, spec) in enumerate(jobs):
+            buf = pending.pop(0).result()
+            nxt = i + prefetch_depth
+            if nxt < len(jobs):
+                pending.append(ex.submit(fetch, jobs[nxt]))
             mv = memoryview(buf)
             start = spec.start
 
@@ -221,6 +248,8 @@ def _deliver_pipelined(reader: PeerBlobReader, key: str, mesh, plan,
                 return _mv[off - _s:off - _s + ln]
 
             np_dtype = _np_dtype(spec.dtype)
+            if name in out.arrays:
+                raise ValueError(f"duplicate tensor across shards: {name}")
             sharding = plan.sharding_for(name, spec.shape, np_dtype.itemsize)
             out.arrays[name] = place_tensor(
                 read_at, spec.shape, np_dtype, spec.start, sharding, cast_to)
@@ -313,41 +342,73 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
                                if p.rstrip("/") != peer]
     else:
         peer_order = [peer]
+    weight_files = []
     for f in manifest.get("files", []):
-        name, key = f["name"], f["key"]
-        if not is_weight_file(name, f.get("media_type", "")):
+        if not is_weight_file(f["name"], f.get("media_type", "")):
             continue
-        size = int(f.get("size") or 0)
-        if size <= 0:
-            raise IOError(f"manifest entry {name} lacks a size")
-        placed = None
-        last_err: Exception | None = None
-        for source_peer in peer_order:
-            reader = PeerBlobReader(source_peer, key, size, streams=streams)
-            try:
-                if name.endswith(".safetensors"):
-                    if jax.process_count() == 1:
-                        placed = _deliver_pipelined(reader, key, mesh, plan,
-                                                    cast_to=cast_to)
-                    else:
+        if int(f.get("size") or 0) <= 0:
+            raise IOError(f"manifest entry {f['name']} lacks a size")
+        weight_files.append(f)
+
+    # single-process safetensors: one prefetch pipeline over ALL tensors
+    # of ALL files in manifest order — tensor N's device transfer overlaps
+    # tensor N+1..N+depth's downloads with no bubble at file boundaries
+    pipelined = False
+    if (jax.process_count() == 1
+            and weight_files
+            and all(f["name"].endswith(".safetensors")
+                    for f in weight_files)):
+        try:
+            jobs = []
+            for f in weight_files:
+                reader, index = _reader_and_index(f, peer_order, streams)
+                readers.append(reader)
+                for tname, spec in index.tensors.items():
+                    jobs.append((reader, f["key"], tname, spec))
+            merge_placement(placement, _deliver_jobs_pipelined(
+                jobs, mesh, plan, cast_to=cast_to))
+            report["weight_bytes"] += sum(int(f["size"])
+                                          for f in weight_files)
+            pipelined = True
+        except OSError as e:
+            # mid-pipeline peer failure: rebuild from scratch on the
+            # per-file failover path below (the placement so far is
+            # discarded; device transfers redo — this is the error path)
+            log.warning("pipelined delivery failed (%s); retrying with "
+                        "per-file failover", e)
+            placement = Placement(mesh_desc=f"{dict(mesh.shape)}")
+            report["weight_bytes"] = 0
+
+    if not pipelined:
+        from demodel_tpu.sink.hbm import deliver_gguf
+
+        for f in weight_files:
+            name, key = f["name"], f["key"]
+            size = int(f["size"])
+            placed = None
+            last_err: Exception | None = None
+            for source_peer in peer_order:
+                reader = PeerBlobReader(source_peer, key, size,
+                                        streams=streams)
+                try:
+                    if name.endswith(".safetensors"):
                         placed = deliver_safetensors(
                             reader, key, mesh=mesh, plan=plan,
                             cast_to=cast_to, ici_complete=ici_complete)
-                else:
-                    from demodel_tpu.sink.hbm import deliver_gguf
-
-                    placed = deliver_gguf(reader, key, mesh=mesh, plan=plan)
-                readers.append(reader)
-                break
-            except OSError as e:  # incl. IOError + requests exceptions
-                last_err = e
-                readers.append(reader)  # count the wasted bytes honestly
-                log.warning("delivery of %s from %s failed (%s); trying "
-                            "next peer", name, source_peer, e)
-        if placed is None:
-            raise IOError(f"no peer could serve {name}") from last_err
-        merge_placement(placement, placed)
-        report["weight_bytes"] += size
+                    else:
+                        placed = deliver_gguf(reader, key, mesh=mesh,
+                                              plan=plan)
+                    readers.append(reader)
+                    break
+                except OSError as e:  # incl. IOError + requests exceptions
+                    last_err = e
+                    readers.append(reader)  # count wasted bytes honestly
+                    log.warning("delivery of %s from %s failed (%s); "
+                                "trying next peer", name, source_peer, e)
+            if placed is None:
+                raise IOError(f"no peer could serve {name}") from last_err
+            merge_placement(placement, placed)
+            report["weight_bytes"] += size
     jax.block_until_ready(list(placement.arrays.values()))
     report["network_bytes"] = sum(r.bytes_fetched for r in readers)
     report["secs"] = round(time.perf_counter() - t0, 3)
