@@ -360,8 +360,13 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
                     for f in weight_files)):
         try:
             jobs = []
-            for f in weight_files:
-                reader, index = _reader_and_index(f, peer_order, streams)
+            for i, f in enumerate(weight_files):
+                # stripe files round-robin across peers so a multi-peer
+                # pod spreads the DCN load; a peer missing the blob just
+                # falls over to the next in the rotated order
+                rotated = peer_order[i % len(peer_order):] + \
+                    peer_order[:i % len(peer_order)]
+                reader, index = _reader_and_index(f, rotated, streams)
                 readers.append(reader)
                 for tname, spec in index.tensors.items():
                     jobs.append((reader, f["key"], tname, spec))

@@ -355,6 +355,36 @@ def test_pod_pull_15_shard_stream(tmp_path):
     assert len(outs[0]["fp"]) == 15
 
 
+def test_sharded_pull_stripes_across_two_peers(tmp_path, mesh8):
+    """Two warm peers (same upstream → same content-addressed keys): the
+    single-process pipelined pull round-robins files across them — BOTH
+    serve weight bytes — and results stay byte-exact."""
+    from demodel_tpu.sink.remote import pull_manifest_to_hbm
+
+    files, tensors = _build_pod_repo()
+    handler = make_hf_handler({MODEL: files})
+    with FakeUpstream(handler=handler) as up:
+        cfgs = [ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                            cache_dir=tmp_path / f"wp{i}-cache",
+                            data_dir=tmp_path / f"wp{i}-data",
+                            use_ecdsa=True) for i in (0, 1)]
+        for cfg in cfgs:
+            delivery.pull(MODEL, cfg, endpoint=f"http://{up.authority}")
+        with ProxyServer(cfgs[0], verbose=False) as p0, \
+                ProxyServer(cfgs[1], verbose=False) as p1:
+            b0 = p0.metrics()["bytes_cache"]
+            b1 = p1.metrics()["bytes_cache"]
+            report, placed = pull_manifest_to_hbm(
+                MODEL, [p0.url, p1.url], mesh=mesh8)
+            s0 = p0.metrics()["bytes_cache"] - b0
+            s1 = p1.metrics()["bytes_cache"] - b1
+    for name, want in tensors.items():
+        np.testing.assert_array_equal(np.asarray(placed.arrays[name]), want)
+    # both peers carried real weight-file load (striping worked)
+    assert s0 > 1 << 16 and s1 > 1 << 16, \
+        f"striping skew: peer0={s0}B peer1={s1}B"
+
+
 def test_pod_pull_gguf_over_wire(tmp_path, mesh8):
     """GGUF on the pod path: a warm node that pulled an ollama model
     serves it over /peer; a cold store-less consumer places the Q8_0
