@@ -183,6 +183,16 @@ def fetch_manifest(peers: list[str], model: str, source: str = "hf",
                   + (f" (last error: {last_err})" if last_err else ""))
 
 
+def _peer_alive(peer: str, timeout: float = 3.0) -> bool:
+    """Short-deadline liveness probe (``/healthz`` on the native proxy).
+    Only gates which peers join the striping rotation — the manifest
+    peer is already proven by the manifest fetch itself."""
+    try:
+        return requests.get(f"{peer}/healthz", timeout=timeout).ok
+    except requests.RequestException:
+        return False
+
+
 def _reader_and_index(f: dict, peer_order: list[str], streams):
     """Open ``f`` on the first peer that can serve its safetensors index
     (header reads fail over; window reads during delivery are handled by
@@ -327,19 +337,22 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
         "network_bytes": 0, "weight_bytes": 0,
     }
     readers: list[PeerBlobReader] = []
-    # Failover order: the manifest peer first, then the others. A peer
-    # dying mid-pull costs one file re-read from the next peer, not the
-    # placement — but ONLY single-process: on a multi-host mesh a host
-    # that locally retries a file whose earlier tensors already ran their
-    # redistribute() collectives would re-issue those collectives while
-    # the other hosts sit in later ones — same-shaped tensors would pair
-    # silently wrong (corrupt weights), different-shaped ones deadlock.
-    # Multi-host delivery therefore re-raises and lets the caller restart
-    # the pull pod-wide (every host restarts → collective order stays
-    # aligned).
+    # Peer policy, single-process: files stripe round-robin over the
+    # RESPONSIVE peers (pipelined path below rotates the primary per
+    # file), with the rest of the order as failover — a header/window
+    # failure retries the file (or, mid-pipeline, rebuilds via the
+    # per-file path). Peers are liveness-probed once up front with a
+    # short deadline so a hung-but-accepting peer (the wedged-tunnel
+    # shape) never lands on the critical path at its full read timeout.
+    # Multi-host meshes pin everything to the manifest peer and re-raise
+    # on failure: a host that locally retried a file whose earlier
+    # tensors already ran their redistribute() collectives would re-issue
+    # them while other hosts sit in later ones — same-shaped tensors
+    # would pair silently wrong, different shapes deadlock; the caller
+    # restarts the pull pod-wide instead.
     if jax.process_count() == 1:
-        peer_order = [peer] + [p.rstrip("/") for p in peers
-                               if p.rstrip("/") != peer]
+        others = [p.rstrip("/") for p in peers if p.rstrip("/") != peer]
+        peer_order = [peer] + [p for p in others if _peer_alive(p)]
     else:
         peer_order = [peer]
     weight_files = []
