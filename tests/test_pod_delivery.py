@@ -304,6 +304,23 @@ def test_synthesis_republishes_gated_entries(tmp_path):
         s.close()
 
 
+def test_materialize_aux_files(warm_peer, tmp_path):
+    """Non-weight files (config/tokenizer/index) of a peer-held model
+    materialize to disk for consumers; weight bytes stay off this path."""
+    peer_url, _tensors, _ = warm_peer
+    from demodel_tpu.sink.remote import fetch_manifest, materialize_aux_files
+
+    peer, manifest = fetch_manifest([peer_url], MODEL)
+    out = materialize_aux_files(manifest, peer, tmp_path / "aux")
+    names = {p.name for p in out}
+    assert "config.json" in names
+    assert "model.safetensors.index.json" in names
+    assert not any(n.endswith(".safetensors") for n in names
+                   if n != "model.safetensors.index.json")
+    cfg = json.loads((tmp_path / "aux" / "config.json").read_text())
+    assert cfg["model_type"] == "llama"
+
+
 def test_pod_pull_15_shard_stream(tmp_path):
     """BASELINE config 5 shape: a 15-shard safetensors checkpoint
     (the Llama-2-70B layout) streamed across pod hosts — each host's
