@@ -1,4 +1,5 @@
 from demodel_tpu.ops import dequant
+from demodel_tpu.ops.flash_attention import flash_attention
 from demodel_tpu.ops.ring_attention import ring_attention
 
-__all__ = ["dequant", "ring_attention"]
+__all__ = ["dequant", "flash_attention", "ring_attention"]

@@ -6,8 +6,9 @@ from demodel_tpu.sink.hbm import (
     place_tensor,
 )
 from demodel_tpu.sink.plan import ShardingPlan
+from demodel_tpu.sink.remote import PeerBlobReader, pull_manifest_to_hbm
 from demodel_tpu.sink.streaming import StreamingSink
 
 __all__ = ["Placement", "deliver_gguf", "deliver_report_to_hbm",
-           "deliver_safetensors", "place_tensor", "ShardingPlan",
-           "StreamingSink"]
+           "deliver_safetensors", "place_tensor", "PeerBlobReader",
+           "pull_manifest_to_hbm", "ShardingPlan", "StreamingSink"]
