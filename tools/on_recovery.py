@@ -1,0 +1,102 @@
+"""One-shot tunnel-recovery sequence (PROFILE_r04.md checklist).
+
+Run the moment a probe reports ok:true:
+
+1. one full `bench.py` (driver-comparable) — recorded immediately;
+2. a flash-attention compile check on the real chip (the kernel is
+   interpret-tested; this validates Mosaic lowering);
+3. two more spaced bench reps via bench_series (the tunnel wedges under
+   abuse, so reps are separated by a cool-down).
+
+Everything appends to BENCH_SERIES_r04.jsonl / prints JSON lines; commit
+the artifacts after.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _flash_child() -> None:
+    sys.path.insert(0, str(REPO))
+    import os
+
+    import numpy as np
+
+    import jax
+
+    if os.environ.get("FLASH_CHECK_TINY"):
+        # CPU smoke of this script: env vars can't switch the backend (a
+        # sitecustomize registers the TPU first) — only config can
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+    import jax.numpy as jnp
+
+    from demodel_tpu.ops.flash_attention import (
+        flash_attention, reference_attention,
+    )
+
+    dt = jnp.bfloat16
+    # chip shapes by default; FLASH_CHECK_TINY=1 keeps the CPU smoke of
+    # this script itself fast (interpret mode executes grid steps in
+    # Python — the real check runs on the TPU where the kernel compiles)
+    S, H, G, D = (32, 2, 1, 32) if os.environ.get("FLASH_CHECK_TINY") \
+        else (512, 8, 2, 128)
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (1, S, H, D), dt)
+    k = jax.random.normal(ks[1], (1, S, G, D), dt)
+    v = jax.random.normal(ks[2], (1, S, G, D), dt)
+    t0 = time.time()
+    out = flash_attention(q, k, v, causal=True)
+    out.block_until_ready()
+    compile_s = time.time() - t0
+    t0 = time.time()
+    out = flash_attention(q, k, v, causal=True)
+    out.block_until_ready()
+    run_s = time.time() - t0
+    ref = reference_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                              v.astype(jnp.float32), causal=True)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    print(json.dumps({"flash_on_chip": True,
+                      "compile_s": round(compile_s, 1),
+                      "run_s": round(run_s, 4),
+                      "max_err_vs_ref": err,
+                      "ok": bool(err < 0.1 and np.isfinite(err))}))
+
+
+def main() -> int:
+    if "--flash-child" in sys.argv:
+        _flash_child()
+        return 0
+    print("[recovery] step 1: driver-comparable bench", file=sys.stderr)
+    subprocess.run([sys.executable, str(REPO / "tools/bench_series.py"),
+                    "1"], timeout=1800)
+    print("[recovery] step 2: flash kernel on-chip compile check",
+          file=sys.stderr)
+    try:
+        r = subprocess.run([sys.executable, __file__, "--flash-child"],
+                           capture_output=True, text=True, timeout=600)
+        print(r.stdout.strip() or r.stderr[-500:])
+        with open(REPO / "BENCH_SERIES_r04.jsonl", "a") as f:
+            f.write(json.dumps({"flash_check": r.stdout.strip()[-1500:]})
+                    + "\n")
+    except subprocess.TimeoutExpired:
+        print('{"flash_on_chip": false, "error": "timeout"}')
+    print("[recovery] step 3: two spaced bench reps", file=sys.stderr)
+    for _ in range(2):
+        time.sleep(120)  # cool-down: the tunnel wedges under abuse
+        subprocess.run([sys.executable, str(REPO / "tools/bench_series.py"),
+                        "1"], timeout=1800)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
