@@ -81,8 +81,18 @@ def reference_attention_lse(q, k, v, causal: bool = True, scale=None,
         causal_offset = kv_len - Sq
     causal_offset = jnp.asarray(causal_offset, jnp.int32)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    mask = _mask(Sq, Sk, kv_len, causal, causal_offset)
-    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    if kv_len.ndim == 0 and causal_offset.ndim == 0:
+        mask = _mask(Sq, Sk, kv_len, causal, causal_offset)[None, None]
+    else:
+        # per-batch validity (ragged batched decode): broadcast to (B,1,Sq,Sk)
+        kvb = jnp.broadcast_to(kv_len, (B,))[:, None, None, None]
+        offb = jnp.broadcast_to(causal_offset, (B,))[:, None, None, None]
+        ki = jnp.arange(Sk)[None, None, None, :]
+        qi = jnp.arange(Sq)[None, None, :, None]
+        mask = ki < kvb
+        if causal:
+            mask = mask & (ki <= qi + offb)
+    scores = jnp.where(mask, scores, NEG_INF)
     lse = jax.scipy.special.logsumexp(scores, axis=-1)  # (B, H, Sq)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
@@ -113,8 +123,9 @@ def _flash_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    sk_actual = scalars_ref[0]
-    offset = scalars_ref[1]
+    b = pl.program_id(0)
+    sk_actual = scalars_ref[2 + 2 * b]       # per-batch valid key prefix
+    offset = scalars_ref[2 + 2 * b + 1]      # per-batch diagonal shift
     # skip K blocks that are entirely invalid (past kv_len) or entirely
     # above the causal diagonal — decode over a long, mostly-empty cache
     # then costs only the filled prefix
@@ -188,8 +199,16 @@ def _flash_forward(q, k, v, kv_len, causal_offset, causal, scale, block_q,
     kv_len = jnp.asarray(kv_len, jnp.int32)
     if causal_offset is None:
         causal_offset = kv_len - Sq
-    scalars = jnp.stack([kv_len,
-                         jnp.asarray(causal_offset, jnp.int32)])
+    causal_offset = jnp.asarray(causal_offset, jnp.int32)
+    # SMEM scalar layout: 2 reserved slots, then per-batch
+    # [kv_len, causal_offset] pairs (scalars broadcast across the batch;
+    # vectors give ragged batched decode its per-example windows)
+    kvb = jnp.broadcast_to(kv_len, (B,))
+    offb = jnp.broadcast_to(causal_offset, (B,))
+    scalars = jnp.concatenate([
+        jnp.zeros((2,), jnp.int32),
+        jnp.stack([kvb, offb], axis=1).reshape(-1),
+    ])
 
     out, lse = pl.pallas_call(
         functools.partial(

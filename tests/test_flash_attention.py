@@ -79,6 +79,20 @@ def test_flash_dynamic_kv_len():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_flash_per_batch_kv_len():
+    """Ragged batched decode: each example carries its own filled-cache
+    length; rows match per-example reference attention."""
+    q, k, v = _mk(3, 4, 64, 4, 2, 32, seed=15)
+    lens = jnp.asarray([17, 64, 40], jnp.int32)
+    got = flash_attention(q, k, v, kv_len=lens, causal=True,
+                          block_q=4, block_k=16)
+    for b, n in enumerate([17, 64, 40]):
+        want = reference_attention(q[b:b + 1], k[b:b + 1, :n],
+                                   v[b:b + 1, :n], causal=True)
+        np.testing.assert_allclose(np.asarray(got[b:b + 1]),
+                                   np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
 def test_llama_decode_cache_parity_with_flash(monkeypatch):
     """DEMODEL_FLASH_ATTN=1 on the cached decode path: same logits as
     the einsum cache attention, step by step."""
