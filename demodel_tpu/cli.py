@@ -296,6 +296,11 @@ def build_parser() -> argparse.ArgumentParser:
              "(lets a foreign-client-warmed node seed pod pulls/restore)")
     mf.add_argument("model")
     mf.add_argument("--source", default="hf", choices=["hf", "ollama"])
+    mf.add_argument(
+        "--include-private", action="store_true",
+        help="explicitly republish auth-scoped (gated-repo) cache "
+             "entries under public peer-servable keys; without this, "
+             "gated bytes are omitted from the synthesized manifest")
     return p
 
 
@@ -318,9 +323,10 @@ def main(argv: list[str] | None = None) -> int:
 
         store = open_store(cfg)
         try:
-            record = synthesize_manifest(store, args.model,
-                                         source=args.source)
-        except FileNotFoundError as e:
+            record = synthesize_manifest(
+                store, args.model, source=args.source,
+                include_private=args.include_private)
+        except (FileNotFoundError, PermissionError) as e:
             print(str(e), file=sys.stderr)
             return 1
         finally:

@@ -286,7 +286,8 @@ def pull_to_hbm(
 
 
 def synthesize_manifest(store: Store, model: str, source: str = "hf",
-                        persist: bool = True) -> dict:
+                        persist: bool = True,
+                        include_private: bool = False) -> dict:
     """Build a model-manifest record out of a PROXY-warmed cache — no
     first-party pull required.
 
@@ -310,10 +311,12 @@ def synthesize_manifest(store: Store, model: str, source: str = "hf",
     from demodel_tpu.store import key_for_uri
 
     if source == "ollama":
-        return _synthesize_ollama_manifest(store, model, persist=persist)
+        return _synthesize_ollama_manifest(
+            store, model, persist=persist, include_private=include_private)
     pat = _re.compile(
         _re.escape(model) + r"/resolve/([^/]+)/(.+)$")
     files: dict[str, dict] = {}  # filename → entry (first revision wins)
+    skipped_private: list[str] = []
     for key in store.list():
         meta = store.meta(key) or {}
         uri = meta.get("uri", "")
@@ -340,9 +343,13 @@ def synthesize_manifest(store: Store, model: str, source: str = "hf",
             if store.is_private(key):
                 # gated-repo entry (auth-scoped): the peer plane refuses
                 # private keys, so a manifest referencing one would 404.
-                # Synthesis is the operator explicitly re-sharing the
-                # model — copy-republish under a public key, re-hashing
-                # against the digest recorded at commit time.
+                # Republishing it under a public key makes the bytes
+                # world-readable on the unauthenticated /peer plane —
+                # that needs an explicit opt-in, not a side effect of
+                # manifest synthesis (advisor r4, medium).
+                if not include_private:
+                    skipped_private.append(name)
+                    continue
                 entry_key = key_for_uri(f"demodel://synth/{model}/{name}")
                 if not store.has(entry_key):
                     w = store.begin(entry_key)
@@ -366,6 +373,34 @@ def synthesize_manifest(store: Store, model: str, source: str = "hf",
             "name": name, "key": entry_key, "size": store.size(entry_key),
             "sha256": sha, "revision": rev, "media_type": "",
         })
+    _WEIGHT_SUFFIXES = (".safetensors", ".bin", ".pt", ".pth", ".gguf",
+                        ".onnx", ".msgpack", ".h5")
+    # a gated copy of a file whose PUBLIC copy made it into the manifest
+    # (repo un-gated later; two cached revisions) is not a loss at all
+    skipped_private = [n for n in skipped_private if n not in files]
+    skipped_weights = [n for n in skipped_private
+                       if n.endswith(_WEIGHT_SUFFIXES)]
+    if skipped_weights or (skipped_private and not files):
+        # never persist/advertise a weightless manifest: a peer pull
+        # would "succeed" and fail confusingly at restore time — an
+        # omitted README is survivable, omitted weights are not
+        what = (f"including weights: {', '.join(sorted(skipped_weights)[:5])}"
+                if skipped_weights else
+                f"and nothing public is cached: "
+                f"{', '.join(sorted(skipped_private)[:5])}")
+        raise PermissionError(
+            f"{len(skipped_private)} cached file(s) for {model} are "
+            f"auth-scoped, {what} — rerun with include_private=True / "
+            "--include-private to explicitly republish them on the "
+            "public peer plane. (Note: a logged-in hf client sends its "
+            "token on PUBLIC repos too, marking them auth-scoped here; "
+            "if this repo is public, --include-private is safe.)")
+    if skipped_private:
+        log.warning(
+            "manifest for %s omits %d auth-scoped (gated-repo) file(s): "
+            "%s — pass include_private=True / --include-private to "
+            "republish them on the public peer plane", model,
+            len(skipped_private), ", ".join(sorted(skipped_private)[:5]))
     if not files:
         raise FileNotFoundError(
             f"no cached objects match {model}/resolve/ — was the model "
@@ -382,12 +417,20 @@ def synthesize_manifest(store: Store, model: str, source: str = "hf",
 
 
 def _synthesize_ollama_manifest(store: Store, model: str,
-                                persist: bool = True) -> dict:
+                                persist: bool = True,
+                                include_private: bool = False) -> dict:
     """Ollama flavor of :func:`synthesize_manifest`: the proxy cached the
     registry-v2 manifest under its ``/v2/{name}/manifests/{tag}`` URI and
     every layer under its ``blobs/{digest}`` URI — resolve the manifest,
     map layers to their cached blob keys, persist the pull-shaped
-    record."""
+    record.
+
+    ``include_private`` is accepted for signature parity with the HF
+    flavor but has no effect: registry-v2 bearer tokens are mandatory
+    even for public pulls, so auth presence is not a gating signal here
+    (token-scoped layers republish with digest verification + warning).
+    """
+    del include_private  # see docstring
     import json as _json
 
     from demodel_tpu.registry.ollama import normalize_name
@@ -413,12 +456,15 @@ def _synthesize_ollama_manifest(store: Store, model: str,
             f"no cached registry-v2 manifest matches {suffix} — was "
             "the model pulled through this proxy?")
     base = manifest_uri.split("?", 1)[0][: -len(suffix)]
-    # blob URI → cached key, INCLUDING auth-scoped entries: a wire pull
-    # through the registry token dance caches blobs under credentialed
-    # keys (private, no digest link — gated bytes must never launder into
-    # the public index automatically). Synthesis is the operator
-    # explicitly re-sharing this model, so those entries are located by
-    # their recorded URI and re-published below with digest verification.
+    # blob URI → cached key. NOTE on auth semantics (reviewer r5): the
+    # registry-v2 token dance is protocol-MANDATORY — `ollama pull` of a
+    # fully public model still sends `Authorization: Bearer <anonymous
+    # token>` on every blob fetch, so auth_scope presence carries NO
+    # gating signal here (unlike the HF flavor, where anonymous pulls
+    # are the norm and the include_private gate applies). Credentialed
+    # copies are republished with digest verification against the
+    # manifest — the content-address proof — plus a loud warning; a
+    # truly private registry's operator is warned not to synthesize.
     by_uri: dict[str, str] = {}
     for key in store.list():
         meta = store.meta(key) or {}
@@ -426,6 +472,7 @@ def _synthesize_ollama_manifest(store: Store, model: str,
         if f"/v2/{name}/blobs/" in uri:
             by_uri.setdefault(uri, key)
     files = []
+    republished_scoped = 0
     layers = list(manifest.get("layers", []))
     if manifest.get("config"):
         layers.append(manifest["config"])
@@ -436,7 +483,11 @@ def _synthesize_ollama_manifest(store: Store, model: str,
         blob_key = key_for_uri(blob_uri)
         if not store.has(blob_key):
             src_key = by_uri.get(blob_uri)
-            if src_key is None and not store.has_digest(sha):
+            # a public digest-indexed copy of the same bytes beats a
+            # credentialed copy: prefer the zero-copy materialize path
+            if store.has_digest(sha):
+                src_key = None
+            elif src_key is None:
                 raise FileNotFoundError(
                     f"layer {digest[:19]} of {model} not in the cache")
             blob_key = key_for_uri(f"demodel://synth/{model}/{sha}")
@@ -447,9 +498,11 @@ def _synthesize_ollama_manifest(store: Store, model: str,
                     # public bytes already digest-indexed: zero-copy link
                     store.materialize(blob_key, sha, pub_meta)
                 else:
-                    # auth-scoped copy: re-hash while copying — the
+                    # credentialed copy: re-hash while copying — the
                     # manifest digest is the integrity proof that these
                     # are exactly the registry's content-addressed bytes
+                    if store.is_private(src_key):
+                        republished_scoped += 1
                     w = store.begin(blob_key)
                     try:
                         for chunk in store.stream(src_key):
@@ -471,6 +524,14 @@ def _synthesize_ollama_manifest(store: Store, model: str,
             "sha256": digest.split(":", 1)[-1],
             "media_type": layer.get("mediaType", ""),
         })
+    if republished_scoped:
+        log.warning(
+            "ollama manifest for %s republished %d token-scoped layer(s) "
+            "on the public peer plane (registry-v2 bearer tokens are "
+            "protocol-mandatory, so auth presence does not imply a "
+            "private registry — do NOT run `manifest` against models "
+            "pulled from a credentials-gated registry)",
+            model, republished_scoped)
     record = {"name": model, "source": "ollama", "synthesized": True,
               "files": sorted(files, key=lambda f: f["name"])}
     if persist:

@@ -152,7 +152,12 @@ def _flash_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_prev = m_ref[:, 0]
         m_new = jnp.maximum(m_prev, s.max(axis=-1))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
+        # zero masked entries explicitly: a row with NO visible key in a
+        # live block has every s == NEG_INF, so m_new == NEG_INF and
+        # exp(s - m_new) == 1 for all entries — without this, l would
+        # accumulate block_k and the finalize's l==0 guard never fires
+        # (the output would silently become mean(V) instead of zeros)
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
         l_ref[:, 0] = l_ref[:, 0] * alpha + p.sum(axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + p @ v
         m_ref[:, 0] = m_new

@@ -145,9 +145,12 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
         scores = jnp.where(valid[None, None], scores, NEG_INF)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[..., None])
-        # exp(NEG_INF - m_new) underflows to 0 — masked keys contribute
-        # nothing; no NaN path because NEG_INF is finite
+        # masked entries are zeroed EXPLICITLY, not via underflow: a row
+        # with zero visible keys this step has m_new == NEG_INF, so
+        # exp(scores - m_new) would be 1 (not 0) for every masked entry
+        # and den would silently accumulate Tk (output = mean of V)
+        p = jnp.where(valid[None, None],
+                      jnp.exp(scores - m_new[..., None]), 0.0)
         num = num * alpha[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
         den = den * alpha + p.sum(axis=-1)

@@ -111,6 +111,10 @@ class ProxyServer:
             c.c_void_p, c.c_char_p, c.c_char_p, c.c_int64, c.c_int64,
         ]
         L.dm_proxy_register_tensor.restype = None
+        L.dm_proxy_unregister_model.argtypes = [c.c_void_p, c.c_char_p]
+        L.dm_proxy_unregister_model.restype = None
+        L.dm_proxy_unregister_tensor.argtypes = [c.c_void_p, c.c_char_p]
+        L.dm_proxy_unregister_tensor.restype = None
         L._proxy_sigs_done = True
 
     # -- lifecycle -------------------------------------------------------
@@ -138,6 +142,20 @@ class ProxyServer:
         self._lib.dm_proxy_register_tensor(
             self._h, f"{model}/{tensor}".encode(), key.encode(),
             start, nbytes)
+
+    def unregister_model(self, model: str) -> None:
+        """Drop every ``model/*`` entry from the native restore map and
+        release its pins (full teardown). For re-registration use
+        register_tensor for the new set (same-name entries replace
+        atomically) + unregister_tensor for the stale names — a drop-all
+        window would briefly 404 live fetches of kept tensors."""
+        self._lib.dm_proxy_unregister_model(self._h, model.encode())
+
+    def unregister_tensor(self, model: str, tensor: str) -> None:
+        """Drop one tensor entry from the native restore map, releasing
+        its pin — the per-entry half of a stale-tensor sweep."""
+        self._lib.dm_proxy_unregister_tensor(
+            self._h, f"{model}/{tensor}".encode())
 
     def metrics(self) -> dict:
         buf = ctypes.create_string_buffer(1024)

@@ -80,17 +80,29 @@ class RestoreRegistry:
             self.store.pin(key)
         with self._lock:
             old_keys = self._pinned.pop(model, [])
+            stale = set(self._models.get(model, ())) - set(tensors)
             self._pinned[model] = list(keys)
             self._models[model] = tensors
             native = self._native
-        for key in old_keys:
-            self.store.unpin(key)
         if native is not None:
             # mirror the mapping into the C++ data plane: tensor bytes then
-            # serve from the proxy port via sendfile, GIL-free
+            # serve from the proxy port via sendfile, GIL-free. New-set
+            # entries first (same-name tensors replace atomically under
+            # the native lock, pin-new-before-unpin-old), THEN drop only
+            # the names absent from the new set — a drop-all-re-add
+            # window would briefly 404 live fetches of kept tensors and
+            # leave their keys unpinned against a concurrent GC
+            # (advisor r4 + reviewer r5)
             for name, loc in tensors.items():
                 native.register_tensor(model, name, loc.key, loc.start,
                                        loc.nbytes)
+            for name in stale:
+                native.unregister_tensor(model, name)
+        # Python-handle pins released only after the native mirror holds
+        # its own pins on every new-set key: no instant at which a kept
+        # blob is pin-free
+        for key in old_keys:
+            self.store.unpin(key)
         log.info("registered model %s: %d tensors", model, len(tensors))
         return len(tensors)
 
@@ -150,6 +162,23 @@ class RestoreRegistry:
     def models(self) -> list[str]:
         with self._lock:
             return sorted(self._models)
+
+    def unregister(self, model: str) -> bool:
+        """Full teardown of a model: drop it from the registry AND the
+        native data plane, releasing every pin so GC can reclaim the
+        checkpoint. Returns False when the model wasn't registered."""
+        with self._lock:
+            if model not in self._models:
+                return False
+            del self._models[model]
+            old_keys = self._pinned.pop(model, [])
+            native = self._native
+        if native is not None:
+            native.unregister_model(model)
+        for key in old_keys:
+            self.store.unpin(key)
+        log.info("unregistered model %s", model)
+        return True
 
     def put_safetensors(self, model: str, src, length: int) -> int:
         """Commit a pushed safetensors blob (``src``: readable stream of

@@ -1876,6 +1876,29 @@ void Proxy::register_tensor(const std::string &model_tensor, TensorLoc loc) {
   restore_map_[model_tensor] = std::move(loc);
 }
 
+void Proxy::unregister_model(const std::string &model) {
+  std::string prefix = model + "/";
+  std::lock_guard<std::mutex> g(restore_mu_);
+  for (auto it = restore_map_.begin(); it != restore_map_.end();) {
+    if (it->first.size() > prefix.size() &&
+        it->first.compare(0, prefix.size(), prefix) == 0) {
+      if (store_) store_->unpin(it->second.key);
+      it = restore_map_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void Proxy::unregister_tensor(const std::string &model_tensor) {
+  std::lock_guard<std::mutex> g(restore_mu_);
+  auto it = restore_map_.find(model_tensor);
+  if (it != restore_map_.end()) {
+    if (store_) store_->unpin(it->second.key);
+    restore_map_.erase(it);
+  }
+}
+
 bool Proxy::lookup_tensor(const std::string &model_tensor, TensorLoc *out) {
   std::lock_guard<std::mutex> g(restore_mu_);
   auto it = restore_map_.find(model_tensor);
@@ -2515,6 +2538,15 @@ void dm_proxy_register_tensor(void *p, const char *model_tensor,
   loc.nbytes = nbytes;
   static_cast<dm::Proxy *>(p)->register_tensor(
       model_tensor ? model_tensor : "", std::move(loc));
+}
+
+void dm_proxy_unregister_model(void *p, const char *model) {
+  static_cast<dm::Proxy *>(p)->unregister_model(model ? model : "");
+}
+
+void dm_proxy_unregister_tensor(void *p, const char *model_tensor) {
+  static_cast<dm::Proxy *>(p)->unregister_tensor(
+      model_tensor ? model_tensor : "");
 }
 
 

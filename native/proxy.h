@@ -121,6 +121,14 @@ class Proxy {
   // native restore data plane: "model/tensor" → byte window
   void register_tensor(const std::string &model_tensor, TensorLoc loc);
   bool lookup_tensor(const std::string &model_tensor, TensorLoc *out);
+  // drop (and unpin) every "model/..." entry: a re-registration with
+  // fewer or renamed tensors must not leave stale tensors fetchable
+  // or their backing keys pinned forever (advisor r4)
+  void unregister_model(const std::string &model);
+  // drop (and unpin) one entry — re-registration removes only the
+  // tensors absent from the new set, so live fetches of kept tensors
+  // never see a drop-all window
+  void unregister_tensor(const std::string &model_tensor);
 
   void record_hint(const std::string &authority, const std::string &location,
                    const std::string &digest);

@@ -7,7 +7,9 @@
 
 #include <algorithm>
 #include <cerrno>
+#include <csignal>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <dirent.h>
 #include <vector>
@@ -225,8 +227,56 @@ static int mkdir_p(const std::string &path) {
   return -errno;
 }
 
+static std::atomic<int64_t> g_store_hid{0};
+
+// /proc/<pid>/stat field 22 (starttime, clock ticks since boot) — the
+// discriminator that survives pid reuse: a recycled pid (or the same
+// pid+hid after a reboot, since pins/ persists on disk) has a different
+// starttime than the one recorded in the marker. Returns -1 when
+// unreadable (no /proc): callers then fall back to kill(pid, 0) alone.
+static long long proc_starttime(long pid) {
+  char path[64];
+  ::snprintf(path, sizeof path, "/proc/%ld/stat", pid);
+  FILE *f = ::fopen(path, "r");
+  if (!f) return -1;
+  char buf[1024];
+  size_t n = ::fread(buf, 1, sizeof buf - 1, f);
+  ::fclose(f);
+  if (n == 0) return -1;
+  buf[n] = 0;
+  // comm (field 2) may contain spaces/parens: scan from the LAST ')'
+  char *p = ::strrchr(buf, ')');
+  if (!p) return -1;
+  p++;  // now at " <state> <ppid> ..." — starttime is the 20th field on
+  long long val = -1;
+  for (int field = 0; field < 20 && p; field++) {
+    while (*p == ' ') p++;
+    if (field == 19) {
+      val = ::strtoll(p, nullptr, 10);
+      break;
+    }
+    p = ::strchr(p, ' ');
+  }
+  return val;
+}
+
+// is the pin marker at `path` (owned by `pid`) backed by a live process?
+// The marker body records the pinner's starttime; mismatch == pid reuse.
+static bool pin_marker_live(const std::string &path, long pid) {
+  if (::kill((pid_t)pid, 0) != 0 && errno == ESRCH) return false;
+  long long now_start = proc_starttime(pid);
+  if (now_start < 0) return true;  // no /proc: kill() is all we have
+  FILE *f = ::fopen(path.c_str(), "r");
+  if (!f) return false;  // marker vanished underneath us
+  long long recorded = -1;
+  if (::fscanf(f, "%lld", &recorded) != 1) recorded = -1;
+  ::fclose(f);
+  if (recorded < 0) return true;  // legacy empty marker: trust kill()
+  return recorded == now_start;
+}
+
 Store *Store::open(const std::string &root, std::string *err) {
-  for (const char *sub : {"", "/objects", "/partial", "/digests"}) {
+  for (const char *sub : {"", "/objects", "/partial", "/digests", "/pins"}) {
     std::string p = root + sub;
     // create parents of root lazily too (cache_dir may not exist yet)
     if (sub[0] == 0) {
@@ -244,10 +294,20 @@ Store *Store::open(const std::string &root, std::string *err) {
       return nullptr;
     }
   }
-  return new Store(root);
+  Store *s = new Store(root);
+  s->hid_ = g_store_hid.fetch_add(1);
+  return s;
 }
 
 Store::~Store() {
+  {
+    // a closing handle takes its pins with it: a daemon that restarts
+    // its ProxyServer (new handle, new hid) must not leave the old
+    // handle's markers pinning keys for the rest of the process's life
+    std::lock_guard<std::mutex> g(pin_mu_);
+    for (auto &p : pinned_) ::unlink(pin_path(p.first).c_str());
+    pinned_.clear();
+  }
   std::lock_guard<std::mutex> g(fd_mu_);
   for (auto &p : fd_cache_) ::close(p.second);
   fd_cache_.clear();
@@ -667,6 +727,17 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
               return a.recency_ns < b.recency_ns;
             });
   int64_t target = max_bytes - max_bytes / 10;
+  std::set<std::string> foreign = foreign_pins();  // other live handles
+  // refresh the snapshot ONLY when pins/ actually changes mid-walk
+  // (restore server starting during a long GC): one stat per candidate
+  // instead of a full readdir per candidate
+  std::string pins_dir = root_ + "/pins";
+  auto pins_mtime = [&pins_dir]() -> int64_t {
+    struct stat st;
+    if (::stat(pins_dir.c_str(), &st) != 0) return -1;
+    return (int64_t)st.st_mtim.tv_sec * 1000000000 + st.st_mtim.tv_nsec;
+  };
+  int64_t pins_seen = pins_mtime();
   for (const Entry &en : entries) {
     if (total <= target) break;
     {
@@ -677,6 +748,12 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
       std::lock_guard<std::mutex> g(pin_mu_);
       if (pinned_.count(en.key)) continue;  // restore-registered: serving
     }
+    int64_t cur = pins_mtime();
+    if (cur != pins_seen) {  // pins changed mid-walk: re-snapshot
+      foreign = foreign_pins();
+      pins_seen = pins_mtime();  // foreign_pins may reap stale markers
+    }
+    if (foreign.count(en.key)) continue;  // pinned by another live handle
     std::string old_meta = meta(en.key);
     if (!old_meta.empty()) drop_digest_ref(en.key, old_meta);
     if (::unlink(obj_path(en.key).c_str()) != 0 && errno != ENOENT) continue;
@@ -702,15 +779,103 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
   return total;
 }
 
+std::string Store::pin_path(const std::string &key) const {
+  return root_ + "/pins/" + key + "." + std::to_string((long)::getpid()) +
+         "." + std::to_string((long long)hid_);
+}
+
+std::set<std::string> Store::foreign_pins() {
+  // pins/<key>.<pid>.<hid> markers persist pins across Store handles:
+  // the restore registry pins on ITS handle, but `demodel gc` runs in a
+  // fresh process whose in-memory pinned_ is empty — without the
+  // markers it would evict blobs the live data plane is actively
+  // advertising (advisor r4). The <hid> discriminates handles WITHIN a
+  // process (the proxy's native store and the registry's Python store
+  // share one root and one pid): without it, the first handle's
+  // unpin-to-zero would delete a marker another handle still relies
+  // on. Markers from dead pids are reaped so a crashed server cannot
+  // pin the cache forever.
+  std::set<std::string> out;
+  DIR *d = ::opendir((root_ + "/pins").c_str());
+  if (!d) return out;
+  struct dirent *e;
+  long self = (long)::getpid();
+  while ((e = ::readdir(d)) != nullptr) {
+    std::string name = e->d_name;
+    size_t dot2 = name.rfind('.');
+    if (dot2 == std::string::npos || dot2 == 0) continue;
+    size_t dot1 = name.rfind('.', dot2 - 1);
+    if (dot1 == std::string::npos || dot1 == 0) continue;
+    char *end = nullptr;
+    long pid = ::strtol(name.c_str() + dot1 + 1, &end, 10);
+    if (end == nullptr || *end != '.' || pid <= 0) continue;
+    long long hid = ::strtoll(name.c_str() + dot2 + 1, &end, 10);
+    if (end == nullptr || *end != 0 || hid < 0) continue;
+    std::string mpath = root_ + "/pins/" + name;
+    if (pid == self && hid == (long long)hid_) {
+      // own (pid, hid) — but pins/ persists across reboots, so the same
+      // pair can collide with a PREVIOUS boot's marker; only a matching
+      // starttime makes it truly ours (authoritative in memory)
+      long long own = proc_starttime(self);
+      FILE *f = ::fopen(mpath.c_str(), "r");
+      long long rec = -1;
+      if (f) {
+        if (::fscanf(f, "%lld", &rec) != 1) rec = -1;
+        ::fclose(f);
+      }
+      if (own < 0 || rec < 0 || rec == own) continue;  // ours
+      ::unlink(mpath.c_str());  // pre-reboot impostor: reap
+      continue;
+    }
+    if (!pin_marker_live(mpath, pid)) {
+      ::unlink(mpath.c_str());  // stale: pinner is gone / pid recycled
+      continue;
+    }
+    out.insert(name.substr(0, dot1));
+  }
+  ::closedir(d);
+  return out;
+}
+
 void Store::pin(const std::string &key) {
   std::lock_guard<std::mutex> g(pin_mu_);
-  pinned_[key]++;
+  if (++pinned_[key] == 1) {
+    // first pin by this handle: drop a marker other handles' GC sees.
+    // The body records our starttime so a recycled pid (or a post-
+    // reboot collision on pid+hid) can't impersonate a live pin.
+    int fd = ::open(pin_path(key).c_str(),
+                    O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+    if (fd < 0) {
+      // in-memory pin still holds for THIS handle, but without the
+      // marker a GC in another process can evict the blob mid-serve —
+      // exactly the advisor-r4 bug; leave a diagnostic trail
+      ::fprintf(stderr,
+                "[demodel-tpu] WARNING: pin marker %s failed (%s): other "
+                "processes' GC may evict this key while it is served\n",
+                pin_path(key).c_str(), ::strerror(errno));
+    }
+    if (fd >= 0) {
+      long long st = proc_starttime((long)::getpid());
+      if (st >= 0) {
+        char buf[32];
+        int n = ::snprintf(buf, sizeof buf, "%lld", st);
+        if (n > 0) {
+          ssize_t w = ::write(fd, buf, (size_t)n);
+          (void)w;
+        }
+      }
+      ::close(fd);
+    }
+  }
 }
 
 void Store::unpin(const std::string &key) {
   std::lock_guard<std::mutex> g(pin_mu_);
   auto it = pinned_.find(key);
-  if (it != pinned_.end() && --it->second <= 0) pinned_.erase(it);
+  if (it != pinned_.end() && --it->second <= 0) {
+    pinned_.erase(it);
+    ::unlink(pin_path(key).c_str());
+  }
 }
 
 std::string Store::list_keys() {

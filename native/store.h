@@ -167,6 +167,13 @@ class Store {
   bool claim_writer(const std::string &key);
   void drop_digest_ref(const std::string &key, const std::string &old_meta);
   void invalidate_index();
+  std::string pin_path(const std::string &key) const;
+  // keys pinned by OTHER Store handles (pins/<key>.<pid>.<hid> markers)
+  // — other processes AND other handles in this process (the proxy's
+  // native store and the registry's Python store are separate handles
+  // over one root, each with its own in-memory refcounts); reaps
+  // markers whose pid is gone so a crashed server can't pin forever
+  std::set<std::string> foreign_pins();
 
   std::string root_;
 
@@ -177,6 +184,7 @@ class Store {
   std::unordered_map<std::string, int> fd_cache_;  // key → open O_RDONLY fd
   std::mutex pin_mu_;
   std::map<std::string, int> pinned_;  // key → pin refcount (GC skips >0)
+  int64_t hid_ = 0;  // per-process handle id disambiguating pin markers
 
   std::mutex index_mu_;
   std::string index_cache_;

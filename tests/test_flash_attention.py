@@ -93,6 +93,42 @@ def test_flash_per_batch_kv_len():
                                    np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+def test_flash_fully_masked_rows_are_zero():
+    """A query row with ZERO visible keys inside a live K block (negative
+    causal_offset pushes early queries before every key) must emit zeros,
+    not mean(V): with every score at NEG_INF the online-softmax m_new
+    stays NEG_INF and exp(s - m_new) == 1 unless masked probabilities are
+    zeroed explicitly (advisor r4)."""
+    q, k, v = _mk(1, 16, 16, 2, 2, 32, seed=21)
+    # offset -8: queries 0..7 see no keys at all; query i>=8 sees i-8+1
+    got = np.asarray(flash_attention(q, k, v, causal=True,
+                                     causal_offset=jnp.int32(-8),
+                                     block_q=8, block_k=8))
+    assert np.all(got[:, :8] == 0.0), "fully-masked rows must be zeros"
+    # visible rows still match the reference restricted to their window
+    want = np.asarray(reference_attention(q, k, v, causal=True,
+                                          causal_offset=jnp.int32(-8)))
+    np.testing.assert_allclose(got[:, 8:], want[:, 8:],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_q_longer_than_kv_tail_rows_zero():
+    """Sq > kv_len with default alignment: queries beyond the filled
+    prefix end up below the diagonal with no visible key — zeros, and
+    finite values for the valid prefix."""
+    q, k, v = _mk(1, 12, 16, 2, 2, 32, seed=23)
+    # kv_len=4, default causal_offset = kv_len - Sq = -8: queries 8..11
+    # see keys 0..3; queries 0..7 see none
+    got = np.asarray(flash_attention(q, k, v, kv_len=jnp.int32(4),
+                                     causal=True, block_q=4, block_k=8))
+    assert np.all(got[:, :8] == 0.0)
+    assert np.all(np.isfinite(got))
+    want = np.asarray(reference_attention(q, k, v, kv_len=jnp.int32(4),
+                                          causal=True))
+    np.testing.assert_allclose(got[:, 8:], want[:, 8:],
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_llama_decode_cache_parity_with_flash(monkeypatch):
     """DEMODEL_FLASH_ATTN=1 on the cached decode path: same logits as
     the einsum cache attention, step by step."""

@@ -879,6 +879,71 @@ def test_native_restore_data_plane(pulled_node, mesh8, tmp_path):
                 np.asarray(result.arrays["layer.0.w"]), src)
 
 
+def test_native_reregistration_drops_stale_tensors(pulled_node, tmp_path):
+    """Advisor r4: re-registering a model with fewer/renamed tensors used
+    to leave the old entries in the native restore map forever — stale
+    tensors stayed fetchable and their backing keys stayed pinned against
+    GC. Registration now drops the model's previous native entries."""
+    from demodel_tpu.formats import safetensors as st2
+
+    store, report = pulled_node
+    registry = RestoreRegistry(store)
+    registry.register_report("org/m", report)
+
+    cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                      cache_dir=store.root.parent,
+                      data_dir=tmp_path / "rereg-data", use_ecdsa=True)
+    with ProxyServer(cfg, verbose=False) as proxy:
+        registry.attach_native(proxy)
+        url = f"{proxy.url}/restore/org/m/tensor"
+        assert requests.get(f"{url}/layer.0.w", timeout=10).status_code == 200
+        assert requests.get(f"{url}/layer.1.w", timeout=10).status_code == 200
+
+        # checkpoint-shape change: single shard, renamed tensor set
+        blob = st2.serialize({"renamed.w": np.full((8, 8), 3.0, np.float32)})
+        store.put("reregnewckpt0001", blob, {})
+        registry.register_safetensors("org/m", ["reregnewckpt0001"])
+
+        assert requests.get(f"{url}/renamed.w", timeout=10).status_code == 200
+        for stale in ("layer.0.w", "layer.0.b", "layer.1.w", "layer.1.b"):
+            assert requests.get(f"{url}/{stale}",
+                                timeout=10).status_code == 404, \
+                f"stale tensor {stale} still fetchable after re-registration"
+
+        # the old checkpoint's keys are unpinned: GC can reclaim them
+        old_keys = {f["key"] for f in report["files"]
+                    if f["name"].endswith(".safetensors")}
+        store.gc(1)
+        assert not any(store.has(k) for k in old_keys), \
+            "replaced checkpoint keys stayed pinned after re-registration"
+        assert store.has("reregnewckpt0001")
+
+
+def test_registry_unregister_full_teardown(pulled_node, tmp_path):
+    """unregister(): the model vanishes from the registry AND the native
+    data plane, and its checkpoint becomes GC-evictable."""
+    store, report = pulled_node
+    registry = RestoreRegistry(store)
+    registry.register_report("org/m", report)
+
+    cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                      cache_dir=store.root.parent,
+                      data_dir=tmp_path / "unreg-data", use_ecdsa=True)
+    with ProxyServer(cfg, verbose=False) as proxy:
+        registry.attach_native(proxy)
+        url = f"{proxy.url}/restore/org/m/tensor/layer.0.w"
+        assert requests.get(url, timeout=10).status_code == 200
+        assert registry.unregister("org/m") is True
+        assert registry.unregister("org/m") is False  # idempotent
+        assert registry.models() == []
+        assert requests.get(url, timeout=10).status_code == 404
+        keys = {f["key"] for f in report["files"]
+                if f["name"].endswith(".safetensors")}
+        store.gc(1)
+        assert not any(store.has(k) for k in keys), \
+            "unregistered checkpoint keys remained pinned"
+
+
 def test_native_data_endpoint_not_localhost_on_wildcard_bind(
         pulled_node, tmp_path):
     """ADVICE r3 high: a proxy bound 0.0.0.0 must NOT advertise

@@ -279,7 +279,8 @@ def test_synthesized_manifest_from_proxy_warmed_cache(tmp_path, mesh8,
 def test_synthesis_republishes_gated_entries(tmp_path):
     """A gated-repo (auth-scoped, private) cache entry cannot be served
     by the peer plane; operator-invoked synthesis copy-republishes it
-    under a public key with digest verification."""
+    under a public key with digest verification — but ONLY under the
+    explicit ``include_private`` opt-in (advisor r4, medium)."""
     import hashlib
 
     from demodel_tpu.delivery import synthesize_manifest
@@ -294,12 +295,59 @@ def test_synthesis_republishes_gated_entries(tmp_path):
             "sha256": hashlib.sha256(body).hexdigest(),
         })
         assert s.is_private("gatedentry000001")
-        record = synthesize_manifest(s, "org/gated")
+        # default: gated bytes are NOT silently made world-readable —
+        # with nothing else cached, the result is an explanatory error
+        with pytest.raises(PermissionError, match="include_private"):
+            synthesize_manifest(s, "org/gated")
+        record = synthesize_manifest(s, "org/gated", include_private=True)
         (entry,) = record["files"]
         assert entry["name"] == "model.safetensors"
         assert entry["key"] != "gatedentry000001"
         assert not s.is_private(entry["key"])  # peer-servable now
         assert s.get(entry["key"]) == body
+    finally:
+        s.close()
+
+
+def test_synthesis_default_omits_gated_keeps_public(tmp_path):
+    """Without the opt-in: a gated NON-weight file is omitted (warn), a
+    gated WEIGHT file is a hard error — a weightless manifest must never
+    persist, a README-less one is survivable."""
+    import hashlib
+
+    from demodel_tpu.delivery import synthesize_manifest
+    from demodel_tpu.store import Store
+
+    pub = st.serialize({"w": np.ones((4, 4), np.float32)})
+    gated_aux = b'{"vocab": {}}'
+    base = "https://hub/org/mixed/resolve/main"
+    s = Store(tmp_path / "store")
+    try:
+        s.put("publicentry00001", pub, {
+            "uri": f"{base}/model.safetensors", "status": 200,
+            "sha256": hashlib.sha256(pub).hexdigest(),
+        })
+        s.put("gatedentry000001", gated_aux, {
+            "uri": f"{base}/tokenizer.json", "status": 200,
+            "auth_scope": "deadbeef00000000",
+            "sha256": hashlib.sha256(gated_aux).hexdigest(),
+        })
+        record = synthesize_manifest(s, "org/mixed")
+        names = [f["name"] for f in record["files"]]
+        assert names == ["model.safetensors"]  # gated aux file omitted
+        record = synthesize_manifest(s, "org/mixed", include_private=True)
+        names = sorted(f["name"] for f in record["files"])
+        assert names == ["model.safetensors", "tokenizer.json"]
+
+        # gated WEIGHTS cannot be silently omitted: hard error instead
+        gated_w = st.serialize({"g": np.zeros((4, 4), np.float32)})
+        s.put("gatedweight00001", gated_w, {
+            "uri": f"{base}/model-00002.safetensors", "status": 200,
+            "auth_scope": "deadbeef00000000",
+            "sha256": hashlib.sha256(gated_w).hexdigest(),
+        })
+        with pytest.raises(PermissionError, match="weights"):
+            synthesize_manifest(s, "org/mixed")
     finally:
         s.close()
 

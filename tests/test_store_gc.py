@@ -116,6 +116,80 @@ def test_read_bumps_gc_recency(store):
     assert not store.has(keys[1])
 
 
+def test_gc_honors_pins_from_another_live_process(store, tmp_path):
+    """Advisor r4: pins were per-Store-instance in-memory state, so
+    `demodel gc` in a fresh process could evict blobs a live restore
+    node was advertising. Pins now persist as pins/<key>.<pid> markers
+    any process's GC walk honors while the pinning pid is alive."""
+    import subprocess
+    import sys
+    import textwrap
+
+    keys = _fill(store, 6)
+    # a SECOND process opens the same store, pins the coldest key, and
+    # stays alive while this process runs GC
+    code = textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {repr(os.getcwd())})
+        from demodel_tpu.store import Store
+        s = Store({repr(str(store.root))})
+        s.pin({repr(keys[0])})
+        print("pinned", flush=True)
+        time.sleep(60)
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "pinned"
+        total, freed, evicted = store.gc(1)
+        assert evicted >= 4
+        assert store.has(keys[0]), \
+            "key pinned by another live process was evicted"
+    finally:
+        proc.kill()
+        proc.wait()
+    # the pinning process is dead now: its marker is stale — reaped,
+    # and the key becomes evictable again (no crashed-server leak)
+    store.gc(1)
+    assert not store.has(keys[0])
+
+
+def test_gc_honors_pins_from_sibling_handle_same_process(store):
+    """Reviewer r5: the shipped config runs TWO Store handles in one
+    process over one root (the registry's Python store + the proxy's
+    native store). Each handle's pins must survive the OTHER handle's
+    GC, and one handle's unpin-to-zero must not delete a marker a
+    sibling handle still relies on."""
+    keys = _fill(store, 6)
+    sibling = Store(store.root)
+    try:
+        sibling.pin(keys[0])
+        store.pin(keys[0])   # both handles pin the same key
+        store.unpin(keys[0])  # this handle lets go; sibling still serves
+        total, freed, evicted = store.gc(1)
+        assert evicted >= 4
+        assert store.has(keys[0]), \
+            "key pinned by a sibling handle was evicted"
+        sibling.unpin(keys[0])
+        store.gc(1)
+        assert not store.has(keys[0])  # last pin gone → evictable
+    finally:
+        sibling.close()
+
+
+def test_gc_reaps_stale_pin_markers(store, tmp_path):
+    """A marker whose pid no longer exists must not pin anything."""
+    keys = _fill(store, 4)
+    pins = store.root / "pins"
+    # pid 4194304+ is above the default pid_max; spoof a dead pinner
+    # (marker format: <key>.<pid>.<handle-id>)
+    (pins / f"{keys[0]}.999999999.0").touch()
+    total, freed, evicted = store.gc(1)
+    assert not store.has(keys[0]), "stale (dead-pid) marker pinned a key"
+    assert not (pins / f"{keys[0]}.999999999.0").exists(), \
+        "stale marker was not reaped"
+
+
 def test_restore_registration_pins_backing_blob(tmp_path):
     """The registry pin: register a model, then squeeze the cache — the
     registered blob survives and the data plane keeps serving."""

@@ -4,7 +4,7 @@ multiple reps, committed, so the driver-comparable number is a
 distribution rather than one lucky/unlucky sample.
 
 Usage: python tools/bench_series.py [reps] [outfile]
-Appends one JSON object per rep to BENCH_SERIES_r04.jsonl and prints a
+Appends one JSON object per rep to BENCH_SERIES_r05.jsonl and prints a
 min/median/max summary.
 """
 
@@ -23,7 +23,7 @@ REPO = Path(__file__).resolve().parent.parent
 def main() -> int:
     reps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
     out_path = Path(sys.argv[2]) if len(sys.argv) > 2 else \
-        REPO / "BENCH_SERIES_r04.jsonl"
+        REPO / "BENCH_SERIES_r05.jsonl"
     values = []
     for i in range(reps):
         proc = subprocess.run([sys.executable, str(REPO / "bench.py")],

@@ -8,7 +8,7 @@ Run the moment a probe reports ok:true:
 3. two more spaced bench reps via bench_series (the tunnel wedges under
    abuse, so reps are separated by a cool-down).
 
-Everything appends to BENCH_SERIES_r04.jsonl / prints JSON lines; commit
+Everything appends to BENCH_SERIES_r05.jsonl / prints JSON lines; commit
 the artifacts after.
 """
 
@@ -85,7 +85,7 @@ def main() -> int:
         r = subprocess.run([sys.executable, __file__, "--flash-child"],
                            capture_output=True, text=True, timeout=600)
         print(r.stdout.strip() or r.stderr[-500:])
-        with open(REPO / "BENCH_SERIES_r04.jsonl", "a") as f:
+        with open(REPO / "BENCH_SERIES_r05.jsonl", "a") as f:
             f.write(json.dumps({"flash_check": r.stdout.strip()[-1500:]})
                     + "\n")
     except subprocess.TimeoutExpired:
