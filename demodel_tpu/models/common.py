@@ -27,11 +27,13 @@ def layer_norm(x, weight, bias, eps: float = 1e-5):
 
 
 def use_flash_attention() -> bool:
-    """DEMODEL_FLASH_ATTN=1 routes model attention through the fused
-    pallas kernel (ops/flash_attention.py). Default off: the einsum path
-    lets XLA fuse freely at short sequence; flash wins once the score
-    tensor — or the GQA-repeated KV cache — dominates HBM."""
-    import os
+    """Route model attention through the fused pallas kernel
+    (ops/flash_attention.py)? DEMODEL_FLASH_ATTN forces either way;
+    unset, the default is ON on a TPU backend once the committed on-chip
+    parity record exists (ops/flash_default.py — VERDICT r4 #2), OFF
+    elsewhere: the einsum path lets XLA fuse freely at short sequence,
+    flash wins once the score tensor or GQA-repeated KV cache dominates
+    HBM."""
+    from demodel_tpu.ops.flash_default import use_flash_attention as _p
 
-    return os.environ.get("DEMODEL_FLASH_ATTN", "").strip().lower() in (
-        "1", "true", "yes", "on")
+    return _p()

@@ -1,0 +1,73 @@
+"""Default policy for the fused pallas attention kernels (VERDICT r4 #2).
+
+The kernels are parity/grad-tested in interpret mode on CPU, but SMEM
+scalar prefetch, ``@pl.when``-persistent scratch, and GQA index maps are
+exactly the constructs that lower differently (or fail) under Mosaic on
+real TPU. The defaults therefore flip on only when BOTH hold:
+
+- the process is actually running on a TPU backend, and
+- an on-chip validation record exists — written by
+  ``tools/on_recovery.py`` after a green compile+parity run on real
+  silicon and committed next to this module, so a validated build ships
+  flash-on for every user.
+
+Explicit env settings always win, in both directions:
+``DEMODEL_FLASH_ATTN=1`` forces the kernel anywhere (interpret mode off
+TPU), ``DEMODEL_FLASH_ATTN=0`` forces the einsum path even on validated
+silicon. Same contract for ``DEMODEL_FLASH_RING``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+#: committed by tools/on_recovery.py after an on-chip parity pass
+ONCHIP_RECORD = Path(__file__).parent / "_flash_onchip_validated.json"
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+def _env_flag(name: str) -> bool | None:
+    """Tri-state env read: True / False when set either way, None when
+    unset (policy decides)."""
+    raw = os.environ.get(name, "").strip().lower()
+    if raw in _TRUE:
+        return True
+    if raw in _FALSE:
+        return False
+    return None
+
+
+def flash_validated_on_chip() -> bool:
+    """True when a committed on-chip parity record says the kernels
+    compiled under Mosaic and matched the einsum oracle on real TPU."""
+    try:
+        rec = json.loads(ONCHIP_RECORD.read_text())
+    except (OSError, ValueError):
+        return False
+    return bool(rec.get("ok"))
+
+
+def _default_on() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu" and flash_validated_on_chip()
+
+
+def use_flash_attention() -> bool:
+    """Should model attention route through the fused pallas kernel?"""
+    env = _env_flag("DEMODEL_FLASH_ATTN")
+    if env is not None:
+        return env
+    return _default_on()
+
+
+def use_flash_ring() -> bool:
+    """Should ring attention compute each step with the fused kernel?"""
+    env = _env_flag("DEMODEL_FLASH_RING")
+    if env is not None:
+        return env
+    return _default_on()

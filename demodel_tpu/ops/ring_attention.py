@@ -49,14 +49,14 @@ def dense_attention(q, k, v, causal: bool = True,
 
 
 def _use_flash_ring() -> bool:
-    """DEMODEL_FLASH_RING=1 computes each ring step with the fused pallas
-    kernel (ops/flash_attention.py) and combines the per-step partials in
-    log space — no (B,H,Tq,Tk) score tensor per step, and no GQA head
-    repeat riding the ppermute."""
-    import os
+    """Compute each ring step with the fused pallas kernel
+    (ops/flash_attention.py), combining per-step partials in log space —
+    no (B,H,Tq,Tk) score tensor per step, and no GQA head repeat riding
+    the ppermute? DEMODEL_FLASH_RING forces either way; unset, defaults
+    ON on validated TPU silicon (ops/flash_default.py)."""
+    from demodel_tpu.ops.flash_default import use_flash_ring as _p
 
-    return os.environ.get("DEMODEL_FLASH_RING", "").strip().lower() in (
-        "1", "true", "yes", "on")
+    return _p()
 
 
 def _ring_attention_flash(q, k, v, axis_name, causal, scale, kv_len):

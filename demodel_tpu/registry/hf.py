@@ -29,6 +29,13 @@ DEFAULT_PATTERNS = (
     "*.model", "tokenizer*", "*.gguf",
 )
 
+#: File classes a dataset snapshot carries (``datasets/`` repos): data
+#: shards plus loading metadata.
+DATASET_PATTERNS = (
+    "*.parquet", "*.arrow", "*.csv", "*.jsonl", "*.json", "*.txt",
+    "README.md", "dataset_infos.json",
+)
+
 
 class HFRegistry:
     def __init__(
@@ -53,10 +60,16 @@ class HFRegistry:
     # -- API ------------------------------------------------------------
     def repo_info(self, repo_id: str, revision: str = "main") -> dict:
         """``GET /api/models/{repo}/revision/{rev}`` → repo JSON (sha,
-        siblings[].rfilename, …)."""
-        return self.fetcher.get_json(
-            f"{self.endpoint}/api/models/{repo_id}/revision/{revision}"
-        )
+        siblings[].rfilename, …). Dataset repos (the reference's first
+        line promises "models and datasets", ``README.md:3``) live under
+        a distinct namespace — ``/api/datasets/{repo}/revision/{rev}``
+        and ``/datasets/{repo}/resolve/...`` — selected here by the
+        ``datasets/`` repo-id prefix, mirroring the Hub's URL shape."""
+        if repo_id.startswith("datasets/"):
+            api = f"{self.endpoint}/api/{repo_id}/revision/{revision}"
+        else:
+            api = f"{self.endpoint}/api/models/{repo_id}/revision/{revision}"
+        return self.fetcher.get_json(api)
 
     def list_files(self, repo_id: str, revision: str = "main") -> list[str]:
         info = self.repo_info(repo_id, revision)
@@ -68,7 +81,8 @@ class HFRegistry:
     # -- pulls ----------------------------------------------------------
     #: extensions stored as LFS blobs on the Hub — a HEAD of their resolve
     #: URL yields the blob sha256 (X-Linked-Etag) before any bytes move
-    LFS_SUFFIXES = (".safetensors", ".gguf", ".bin", ".pt", ".onnx", ".h5")
+    LFS_SUFFIXES = (".safetensors", ".gguf", ".bin", ".pt", ".onnx", ".h5",
+                    ".parquet", ".arrow")
 
     def fetch_file(self, repo_id: str, revision: str, filename: str) -> FileArtifact:
         """Fetch one file via the resolve path (redirects followed; LFS
@@ -90,11 +104,17 @@ class HFRegistry:
         self,
         repo_id: str,
         revision: str = "main",
-        allow_patterns: tuple[str, ...] = DEFAULT_PATTERNS,
+        allow_patterns: tuple[str, ...] | None = None,
         on_file=None,
     ) -> PullReport:
         """Pull a snapshot. ``on_file(artifact)`` fires from the fetch
-        worker as each file completes — the streaming-sink hook."""
+        worker as each file completes — the streaming-sink hook.
+        ``allow_patterns`` defaults per namespace: model file classes, or
+        dataset shards/metadata for ``datasets/`` repos."""
+        if allow_patterns is None:
+            allow_patterns = (DATASET_PATTERNS
+                              if repo_id.startswith("datasets/")
+                              else DEFAULT_PATTERNS)
         t0 = time.perf_counter()
         info = self.repo_info(repo_id, revision)
         commit = info.get("sha", revision)

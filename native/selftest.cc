@@ -12,6 +12,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
+#include <chrono>
 #include <cstdio>
 #include <cstring>
 #include <string>
@@ -131,6 +133,88 @@ static void test_store_concurrent(const std::string &root) {
   for (auto &t : ws) t.join();
   reader.join();
   delete s;
+}
+
+static void test_store_gc_pin_stress(const std::string &root) {
+  // Cross-plane GC/pin race scenario (run under TSan by the test rig):
+  // two sibling handles over one root — the shipped shape: the restore
+  // registry's store + the proxy's store — race writers, readers,
+  // pin/unpin cycles on BOTH handles, and concurrent GC passes. The
+  // determinstic invariant afterwards: a key pinned by the sibling
+  // survives this handle's GC; after unpin it goes.
+  std::string err;
+  dm::Store *a = dm::Store::open(root + "/pinstress", &err);
+  dm::Store *b = dm::Store::open(root + "/pinstress", &err);
+  CHECK(a != nullptr && b != nullptr, "open sibling handles");
+  std::string body(50000, 'x');
+  char key[32];
+  for (int i = 0; i < 12; i++) {
+    ::snprintf(key, sizeof key, "ps%02d000000000000", i);
+    CHECK(a->put(key, body.data(), (int64_t)body.size(), "{}", nullptr) == 0,
+          "seed put");
+  }
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> ts;
+  ts.emplace_back([&] {  // writer churn on handle a
+    int i = 100;
+    std::string junk(40000, 'j');
+    while (!stop.load()) {
+      char k[32];
+      ::snprintf(k, sizeof k, "pw%03d00000000000", i++ % 500);
+      a->put(k, junk.data(), (int64_t)junk.size(), "{}", nullptr);
+    }
+  });
+  ts.emplace_back([&] {  // pin/unpin cycles on handle a
+    while (!stop.load()) {
+      for (int i = 0; i < 12; i++) {
+        char k[32];
+        ::snprintf(k, sizeof k, "ps%02d000000000000", i);
+        a->pin(k);
+        a->unpin(k);
+      }
+    }
+  });
+  ts.emplace_back([&] {  // pin/unpin cycles on the SIBLING handle
+    while (!stop.load()) {
+      for (int i = 0; i < 12; i++) {
+        char k[32];
+        ::snprintf(k, sizeof k, "ps%02d000000000000", i);
+        b->pin(k);
+        b->unpin(k);
+      }
+    }
+  });
+  ts.emplace_back([&] {  // GC pressure from handle a
+    while (!stop.load()) a->gc(400000, nullptr, nullptr);
+  });
+  ts.emplace_back([&] {  // GC pressure from the sibling
+    while (!stop.load()) b->gc(400000, nullptr, nullptr);
+  });
+  ts.emplace_back([&] {  // reader over whatever survives
+    char buf[4096];
+    while (!stop.load()) {
+      for (int i = 0; i < 12; i++) {
+        char k[32];
+        ::snprintf(k, sizeof k, "ps%02d000000000000", i);
+        (void)a->pread(k, buf, sizeof buf, 0);  // absence is fine
+      }
+      (void)b->index_json();
+    }
+  });
+  std::this_thread::sleep_for(std::chrono::milliseconds(600));
+  stop.store(true);
+  for (auto &t : ts) t.join();
+  // deterministic tail: sibling pin beats this handle's GC
+  CHECK(a->put("psfinal000000000", body.data(), (int64_t)body.size(), "{}",
+               nullptr) == 0, "final put");
+  b->pin("psfinal000000000");
+  a->gc(1, nullptr, nullptr);
+  CHECK(a->has("psfinal000000000"), "sibling pin survived GC");
+  b->unpin("psfinal000000000");
+  a->gc(1, nullptr, nullptr);
+  CHECK(!a->has("psfinal000000000"), "unpinned key evicted");
+  delete b;
+  delete a;
 }
 
 static void test_proxy_lifecycle(const std::string &root) {
@@ -264,6 +348,7 @@ int main() {
   test_sha256();
   test_store_basic(root);
   test_store_concurrent(root);
+  test_store_gc_pin_stress(root);
   test_proxy_lifecycle(root);
   test_peer_window_fetch(root);
   if (failures) {

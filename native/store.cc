@@ -755,6 +755,12 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
     }
     if (foreign.count(en.key)) continue;  // pinned by another live handle
     std::string old_meta = meta(en.key);
+    // model-manifest records are byte-trivial but load-bearing: evicting
+    // one silently un-advertises a model whose (pinned) weights are
+    // still being served — pod pulls would fail "no peer holds a
+    // manifest" while every weight byte sits in the cache. They go only
+    // via explicit remove().
+    if (meta_scan(old_meta, "kind") == "model-manifest") continue;
     if (!old_meta.empty()) drop_digest_ref(en.key, old_meta);
     if (::unlink(obj_path(en.key).c_str()) != 0 && errno != ENOENT) continue;
     ::unlink(meta_path(en.key).c_str());

@@ -51,6 +51,23 @@ def build_hf_repo(seed: int = 0, n_shards: int = 1, rows: int = 64) -> dict:
     return files
 
 
+def build_hf_dataset(seed: int = 1, n_shards: int = 2,
+                     rows: int = 4096) -> dict:
+    """Dataset repo: parquet-style data shards (opaque bytes to the cache
+    path — real parquet framing is irrelevant to delivery) + metadata."""
+    rng = np.random.default_rng(seed)
+    files: dict[str, bytes] = {
+        "README.md": b"# fake dataset\n",
+        "dataset_infos.json": json.dumps(
+            {"default": {"splits": {"train": {"num_examples": rows}}}}
+        ).encode(),
+    }
+    for i in range(n_shards):
+        files[f"data/train-{i:05d}-of-{n_shards:05d}.parquet"] = (
+            b"PAR1" + rng.bytes(rows * 16) + b"PAR1")
+    return files
+
+
 def make_hf_handler(repos: dict[str, dict[str, bytes]], commit: str = "c0ffee" * 6 + "c0ff",
                     signed_cdn: bool = False):
     """Handler class over {repo_id: {filename: bytes}}; LFS-style 302→CDN for
@@ -99,9 +116,15 @@ def make_hf_handler(repos: dict[str, dict[str, bytes]], commit: str = "c0ffee" *
 
         def do_GET(self):  # noqa: C901
             path = self.path.split("?", 1)[0]  # hub clients append ?expand=…
-            m = re.match(r"^/api/models/(.+?)/revision/([^/]+)$", path)
+            # dataset repos live under a parallel namespace: the API path
+            # is /api/datasets/{id} and repos keys carry the datasets/
+            # prefix (mirroring the /datasets/{id}/resolve fetch path)
+            m = re.match(r"^/api/(models|datasets)/(.+?)/revision/([^/]+)$",
+                         path)
             if m:
-                repo_id, rev = m.group(1), m.group(2)
+                kind, repo_id, rev = m.groups()
+                if kind == "datasets":
+                    repo_id = f"datasets/{repo_id}"
                 self._count("api")
                 if repo_id not in repos:
                     self._send(404, b'{"error":"RepoNotFound"}')
@@ -124,7 +147,7 @@ def make_hf_handler(repos: dict[str, dict[str, bytes]], commit: str = "c0ffee" *
                     self._send(404, b'{"error":"EntryNotFound"}')
                     return
                 sha = digests[repo_id][fname]
-                if fname.endswith(".safetensors") or fname.endswith(".gguf"):
+                if fname.endswith((".safetensors", ".gguf", ".parquet")):
                     # LFS blob → 302 to CDN (the huggingface.co behavior);
                     # X-Linked-{Etag,Size} are what get_hf_file_metadata
                     # reads. The Location must be ABSOLUTE: the real hub

@@ -23,6 +23,10 @@ coord_port = sys.argv[2]
 peer_url = sys.argv[3]
 model = sys.argv[4]
 mode = sys.argv[5]  # "tp" shards matrices | "dp" replicates everything
+#                     | "tp-expect-fail": the peer is rigged to die
+#                     mid-window — a CLEAN abort (controlled OSError,
+#                     no hang, no partial placement reported as good)
+#                     is the pass condition; the pod then restarts
 
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -43,10 +47,43 @@ from demodel_tpu.sink.remote import pull_manifest_to_hbm  # noqa: E402
 
 assert jax.device_count() == 8 and len(jax.local_devices()) == 4
 
-mesh = make_mesh(8) if mode == "tp" else make_mesh(8, tp=1)
+mesh = make_mesh(8) if mode.startswith("tp") else make_mesh(8, tp=1)
+peers = peer_url.split(",")
+
+# RSS accounting for the scale rehearsal: baseline AFTER jax+mesh init
+# (the runtime's own footprint is not the delivery path's doing), peak at
+# exit — the delta bounds what the pull added (landed shards + buffers).
+# Baseline is CURRENT VmRSS, not ru_maxrss: the high-water mark never
+# decreases, so an early transient would inflate it and make the
+# ceiling assertion vacuous.
+import resource  # noqa: E402
+
+
+def _vm_rss_kb() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+
+rss_baseline_kb = _vm_rss_kb()
+
+if mode == "tp-expect-fail":
+    try:
+        report, placed = pull_manifest_to_hbm(
+            model, peers, mesh=mesh, ici_complete=True)
+    except OSError as e:
+        # the multi-host contract (sink/remote.py): abort cleanly and
+        # let the caller restart the pull pod-wide
+        print(json.dumps({"pid": pid, "aborted": True,
+                          "error": str(e)[:200]}), flush=True)
+        sys.exit(0)
+    print(json.dumps({"pid": pid, "aborted": False}), flush=True)
+    sys.exit(0)
 
 report, placed = pull_manifest_to_hbm(
-    model, [peer_url], mesh=mesh, ici_complete=True)
+    model, peers, mesh=mesh, ici_complete=True)
 
 fps = {name: [float(x) for x in np.asarray(fingerprint(a))]
        for name, a in sorted(placed.arrays.items())}
@@ -56,6 +93,8 @@ out = {
     "network_bytes": report["network_bytes"],
     "weight_bytes": report["weight_bytes"],
     "fp": fps,
+    "rss_baseline_kb": rss_baseline_kb,
+    "rss_peak_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
 }
 if not os.environ.get("DEMODEL_POD_SKIP_REP"):
     rep = placed.arrays["replicated.big"]

@@ -201,6 +201,42 @@ def test_gpt2_bert_forward_parity_with_flash(monkeypatch):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_flash_default_policy(monkeypatch, tmp_path):
+    """Defaults (VERDICT r4 #2): flash is OFF unless (a) the env forces
+    it, or (b) the backend is TPU AND the committed on-chip parity
+    record exists. Env=0 beats even validated silicon."""
+    import json as _json
+
+    from demodel_tpu.ops import flash_default as fd
+
+    monkeypatch.delenv("DEMODEL_FLASH_ATTN", raising=False)
+    monkeypatch.delenv("DEMODEL_FLASH_RING", raising=False)
+    # CPU backend, no record → off
+    monkeypatch.setattr(fd, "ONCHIP_RECORD", tmp_path / "absent.json")
+    assert fd.use_flash_attention() is False
+    assert fd.use_flash_ring() is False
+    # env force-on works anywhere (interpret mode on CPU)
+    monkeypatch.setenv("DEMODEL_FLASH_ATTN", "1")
+    assert fd.use_flash_attention() is True
+    monkeypatch.delenv("DEMODEL_FLASH_ATTN")
+    # validated record alone is NOT enough off-TPU
+    rec = tmp_path / "ok.json"
+    rec.write_text(_json.dumps({"ok": True, "max_err_vs_ref": 0.01}))
+    monkeypatch.setattr(fd, "ONCHIP_RECORD", rec)
+    assert fd.use_flash_attention() is False  # backend is cpu here
+    # TPU backend + record → on by default; env=0 still wins
+    monkeypatch.setattr(fd, "_default_on",
+                        lambda: fd.flash_validated_on_chip())
+    assert fd.use_flash_attention() is True
+    assert fd.use_flash_ring() is True
+    monkeypatch.setenv("DEMODEL_FLASH_RING", "0")
+    assert fd.use_flash_ring() is False
+    # a failed on-chip record must NOT flip defaults
+    rec.write_text(_json.dumps({"ok": False, "error": "mosaic"}))
+    monkeypatch.delenv("DEMODEL_FLASH_RING")
+    assert fd.use_flash_attention() is False
+
+
 def test_flash_grad_matches_reference():
     """custom_vjp recompute backward: grads equal the reference's."""
     q, k, v = _mk(1, 32, 32, 2, 2, 16, seed=11)

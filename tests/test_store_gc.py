@@ -154,6 +154,24 @@ def test_gc_honors_pins_from_another_live_process(store, tmp_path):
     assert not store.has(keys[0])
 
 
+def test_gc_spares_model_manifests(store):
+    """Model-manifest records are load-bearing for pod delivery and
+    byte-trivial: GC must never evict one (a manifest-less node serves
+    every weight byte but answers 'no peer holds a manifest'). Explicit
+    remove() still works."""
+    keys = _fill(store, 5)
+    store.put("manifestrec00001", b'{"files": []}',
+              {"kind": "model-manifest", "model": "org/m", "source": "hf"})
+    time.sleep(0.02)
+    _fill(store, 3, start=100)  # newer junk: manifest is the LRU victim
+    total, freed, evicted = store.gc(1)
+    assert evicted >= 5
+    assert store.has("manifestrec00001"), "GC evicted a model manifest"
+    store.remove("manifestrec00001")
+    assert not store.has("manifestrec00001")
+    del keys
+
+
 def test_gc_honors_pins_from_sibling_handle_same_process(store):
     """Reviewer r5: the shipped config runs TWO Store handles in one
     process over one root (the registry's Python store + the proxy's

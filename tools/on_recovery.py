@@ -65,11 +65,44 @@ def _flash_child() -> None:
     ref = reference_attention(q.astype(jnp.float32), k.astype(jnp.float32),
                               v.astype(jnp.float32), causal=True)
     err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
-    print(json.dumps({"flash_on_chip": True,
-                      "compile_s": round(compile_s, 1),
-                      "run_s": round(run_s, 4),
-                      "max_err_vs_ref": err,
-                      "ok": bool(err < 0.1 and np.isfinite(err))}))
+    ok = bool(err < 0.1 and np.isfinite(err))
+
+    # dequant kernels (ops/dequant.py) share the on-chip gate: same
+    # Mosaic-lowering risk, same record. Oracle = the jnp math path the
+    # kernels wrap (the CPU-delivery fallback, parity-tested in-suite).
+    from demodel_tpu.ops import dequant as dq
+
+    nb = 512  # blocks: multiple of the pallas tile
+    rng = np.random.default_rng(0)
+    d8 = jnp.asarray(rng.standard_normal(nb).astype(np.float16))
+    qs8 = jnp.asarray(rng.integers(-127, 127, (nb, 32), dtype=np.int8))
+    got8 = np.asarray(dq.dequant_q8_0(d8, qs8, jnp.float32))
+    ref8 = np.asarray(dq._q8_0_math(d8, qs8, jnp.float32)).reshape(-1)
+    err8 = float(np.max(np.abs(got8 - ref8)))
+    d4 = jnp.asarray(rng.standard_normal(nb).astype(np.float16))
+    qs4 = jnp.asarray(rng.integers(0, 255, (nb, 16), dtype=np.uint8))
+    got4 = np.asarray(dq.dequant_q4_0(d4, qs4, jnp.float32))
+    ref4 = np.asarray(dq._q4_0_math(d4, qs4, jnp.float32)).reshape(-1)
+    err4 = float(np.max(np.abs(got4 - ref4)))
+    dequant_ok = bool(err8 < 1e-2 and err4 < 1e-2
+                      and np.isfinite(err8) and np.isfinite(err4))
+    ok = ok and dequant_ok
+    rec = {"flash_on_chip": True,
+           "compile_s": round(compile_s, 1),
+           "run_s": round(run_s, 4),
+           "max_err_vs_ref": err,
+           "dequant_max_err": {"q8_0": err8, "q4_0": err4},
+           "backend": jax.default_backend(),
+           "device": str(jax.devices()[0]),
+           "ok": ok}
+    print(json.dumps(rec))
+    if ok and not os.environ.get("FLASH_CHECK_TINY") \
+            and jax.default_backend() == "tpu":
+        # the committed record that flips the flash defaults on for TPU
+        # runs (demodel_tpu/ops/flash_default.py — VERDICT r4 #2)
+        from demodel_tpu.ops.flash_default import ONCHIP_RECORD
+
+        ONCHIP_RECORD.write_text(json.dumps(rec))
 
 
 def main() -> int:
