@@ -1,0 +1,110 @@
+"""Decode-throughput before/after for the flash kernel flip (VERDICT r4
+next #2: "a decode-throughput before/after" is part of Done).
+
+Runs Llama autoregressive decode twice — einsum cache attention vs the
+fused flash kernel (`DEMODEL_FLASH_ATTN`) — on the CURRENT backend and
+prints one JSON line with tok/s for both and the ratio. On the real chip
+this is the number that justifies (or vetoes) the default flip; on CPU
+it smoke-tests the harness (interpret-mode pallas is slow there by
+construction, so the ratio only means something on TPU).
+
+The two runs happen in SUBPROCESSES so each sees its env knob at import
+time and neither inherits the other's compiled cache.
+
+Usage: decode_bench.py [--tiny]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _child() -> None:
+    sys.path.insert(0, str(REPO))
+    import jax
+
+    if os.environ.get("DECODE_BENCH_CPU"):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+    import numpy as np
+
+    from demodel_tpu.models.llama import (
+        LlamaConfig, generate, init_params,
+    )
+
+    tiny = bool(os.environ.get("DECODE_BENCH_TINY"))
+    cfg = LlamaConfig(
+        vocab_size=256,
+        hidden_size=64 if tiny else 1024,
+        num_hidden_layers=2 if tiny else 8,
+        num_attention_heads=4 if tiny else 16,
+        num_key_value_heads=2 if tiny else 4,
+        intermediate_size=128 if tiny else 2816,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    prompt = np.arange(32, dtype=np.int32)[None] % cfg.vocab_size
+    new = 16 if tiny else 64
+    # warmup with the SAME max_new_tokens: generate() sizes the KV cache
+    # as T0 + max_new_tokens, so a different count means a different
+    # static shape and a full recompile inside the timed region
+    jax.block_until_ready(generate(params, cfg, prompt, new))
+    t0 = time.time()
+    out = generate(params, cfg, prompt, new)
+    jax.block_until_ready(out)
+    secs = time.time() - t0
+    print(json.dumps({
+        "flash": os.environ.get("DEMODEL_FLASH_ATTN", "") == "1",
+        "backend": jax.default_backend(),
+        "new_tokens": new,
+        "decode_tok_per_s": round(new / secs, 2),
+    }))
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        _child()
+        return 0
+    env = dict(os.environ)
+    if "--tiny" in sys.argv:
+        env["DECODE_BENCH_TINY"] = "1"
+    results = {}
+    for flash in ("0", "1"):
+        key = "flash" if flash == "1" else "einsum"
+        e = dict(env)
+        e["DEMODEL_FLASH_ATTN"] = flash
+        try:
+            r = subprocess.run([sys.executable, __file__, "--child"],
+                               env=e, capture_output=True, text=True,
+                               timeout=1800)
+        except subprocess.TimeoutExpired:
+            results[key] = {"error": "timeout after 1800s"}
+            continue
+        lines = r.stdout.strip().splitlines()
+        if r.returncode != 0 or not lines:
+            results[key] = {"error": f"rc={r.returncode}: "
+                                     f"{(r.stderr or 'no output')[-300:]}"}
+            continue
+        try:
+            results[key] = json.loads(lines[-1])
+        except ValueError:
+            results[key] = {"error": (r.stderr or lines[-1])[-300:]}
+    ein = results.get("einsum", {}).get("decode_tok_per_s")
+    fla = results.get("flash", {}).get("decode_tok_per_s")
+    out = {"decode_before_after": results}
+    if ein and fla:
+        out["flash_speedup"] = round(fla / ein, 3)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

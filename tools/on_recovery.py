@@ -123,6 +123,25 @@ def main() -> int:
                     + "\n")
     except subprocess.TimeoutExpired:
         print('{"flash_on_chip": false, "error": "timeout"}')
+    print("[recovery] step 2b: decode throughput before/after flash",
+          file=sys.stderr)
+    try:
+        r = subprocess.run([sys.executable,
+                            str(REPO / "tools/decode_bench.py")],
+                           capture_output=True, text=True, timeout=3700)
+        print(r.stdout.strip()[-500:] or r.stderr[-300:])
+        lines = r.stdout.strip().splitlines()
+        if r.returncode == 0 and lines:
+            rec = lines[-1]
+        else:
+            rec = json.dumps({"decode_bench_error":
+                              f"rc={r.returncode}: "
+                              f"{(r.stderr or 'no output')[-300:]}"})
+    except subprocess.TimeoutExpired:
+        rec = json.dumps({"decode_bench_error": "timeout after 3700s"})
+        print(rec)
+    with open(REPO / "BENCH_SERIES_r05.jsonl", "a") as f:
+        f.write(rec + "\n")
     print("[recovery] step 3: two spaced bench reps", file=sys.stderr)
     for _ in range(2):
         time.sleep(120)  # cool-down: the tunnel wedges under abuse
