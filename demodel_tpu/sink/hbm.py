@@ -207,13 +207,16 @@ def deliver_safetensors(
     cast_to=None,
     buffer=None,
     ici_complete: bool | None = None,
+    skip: set | None = None,
 ) -> Placement:
     """Land every tensor of a stored safetensors blob in HBM, sharded.
 
     With ``buffer`` (a bytes-like landing buffer from
     :meth:`~demodel_tpu.parallel.peer.PeerSet.fetch_to_memory`), tensor
     ranges are zero-copy views of host memory — no disk read on the
-    delivery path."""
+    delivery path. ``skip`` names tensors already placed (a failed
+    pipelined attempt's survivors): their windows are neither fetched
+    nor re-transferred."""
     if mesh is None:
         mesh = make_mesh()
     if plan is None:
@@ -237,6 +240,8 @@ def deliver_safetensors(
         ici_complete = False
     out = Placement(mesh_desc=f"{dict(mesh.shape)}")
     for name, spec in index.tensors.items():
+        if skip and name in skip:
+            continue
         np_dtype = _np_dtype(spec.dtype)
         sharding = plan.sharding_for(name, spec.shape, np_dtype.itemsize)
         out.arrays[name] = place_tensor(

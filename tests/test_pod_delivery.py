@@ -263,6 +263,57 @@ def test_mid_window_peer_death_fails_over(warm_peer, mesh8):
         dying.shutdown()
 
 
+def test_mid_window_death_resumes_not_redoes(tmp_path, mesh8):
+    """Efficiency half of VERDICT r4 weak #4: a flaky window late in the
+    pull must cost the REMAINING windows, not a full redo. 8 shards, the
+    peer dies at ~85% — the failover must keep the tensors that landed
+    (byte-exact result) and fetch meaningfully less than wasted + full."""
+    from demodel_tpu.sink.remote import pull_manifest_to_hbm
+
+    n_shards = 8
+    rng = np.random.default_rng(3)
+    tensors, files, weight_map = {}, {}, {}
+    files["config.json"] = json.dumps({"model_type": "llama"}).encode()
+    for i in range(n_shards):
+        name = f"blocks.{i}.w"
+        tensors[name] = rng.standard_normal((256, 256)).astype(np.float32)
+        fname = f"model-{i + 1:05d}-of-{n_shards:05d}.safetensors"
+        files[fname] = st.serialize({name: tensors[name]})
+        weight_map[name] = fname
+    files["model.safetensors.index.json"] = json.dumps(
+        {"metadata": {}, "weight_map": weight_map}).encode()
+    weight_nbytes = sum(a.nbytes for a in tensors.values())
+
+    handler = make_hf_handler({MODEL: files})
+    with FakeUpstream(handler=handler) as up:
+        cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                          cache_dir=tmp_path / "r-cache",
+                          data_dir=tmp_path / "r-data", use_ecdsa=True)
+        delivery.pull(MODEL, cfg, endpoint=f"http://{up.authority}")
+        with ProxyServer(cfg, verbose=False) as peer:
+            # files stripe round-robin over [dying, warm], so the dying
+            # peer serves ~half the traffic: a 0.35x threshold trips
+            # ~70% of the way through the pull
+            dying = _DyingPeerServer(
+                peer.url, die_after_bytes=int(weight_nbytes * 0.35))
+            try:
+                report, placed = pull_manifest_to_hbm(
+                    MODEL, [dying.url, peer.url], mesh=mesh8)
+                assert dying.dead, "peer never died mid-window"
+                assert set(placed.arrays) == set(tensors)
+                for name, want in tensors.items():
+                    np.testing.assert_array_equal(
+                        np.asarray(placed.arrays[name]), want)
+                # resume proof: ~0.7x landed before death stays placed;
+                # only the remainder (+ the in-flight window) refetches
+                # → total ≈ 1.1x. A full redo would be ≥ 0.7 + 1.0.
+                assert report["network_bytes"] <= weight_nbytes * 1.45, \
+                    f"fetched {report['network_bytes']} of " \
+                    f"{weight_nbytes}: placement was redone, not resumed"
+            finally:
+                dying.shutdown()
+
+
 def test_cli_sharded_pull(warm_peer, tmp_path, monkeypatch, capsys):
     """`demodel-tpu pull --sharded --peer URL` drives the pod path from
     the CLI (the operator surface of sink/remote.py)."""
