@@ -73,8 +73,9 @@ class PeerBlobReader:
         self.path = path or f"/peer/object/{remote_key}"
         self._size = int(size)
         self.timeout = timeout
-        self.streams = streams if streams is not None else env_int(
-            "DEMODEL_PEER_STREAMS", 8, minimum=1)
+        from demodel_tpu.parallel.peer import _peer_streams
+
+        self.streams = streams if streams is not None else _peer_streams()
         self._tls = threading.local()
         self._session = session
         self.bytes_fetched = 0
