@@ -39,16 +39,10 @@ def _peer_streams() -> int:
     CPUs the extra sockets just contend (measured −18% at 1 core, 8
     streams vs 1), so the unset-env default is clamped to the core
     count. An explicit env value always wins."""
-    import os
+    from demodel_tpu.utils.env import available_cpus
 
-    # sched_getaffinity sees cgroup/affinity limits (containers pinned
-    # to 1 CPU on a 64-core host); cpu_count() reports the host
-    try:
-        cpus = len(os.sched_getaffinity(0))
-    except (AttributeError, OSError):
-        cpus = os.cpu_count() or 8
-    default = max(1, min(8, cpus))
-    return env_int("DEMODEL_PEER_STREAMS", default, minimum=1)
+    return env_int("DEMODEL_PEER_STREAMS", max(1, min(8, available_cpus())),
+                   minimum=1)
 
 
 @dataclass

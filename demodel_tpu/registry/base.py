@@ -169,7 +169,11 @@ class Fetcher:
         mode = Fetcher._mode_env("DEMODEL_CACHE_COMMIT",
                                  ("eager", "overlap"),
                                  ("deferred", "lazy"))
-        return mode if mode is not None else (os.cpu_count() or 1) >= 4
+        from demodel_tpu.utils.env import available_cpus
+
+        # affinity-aware: a container pinned to 1 CPU on a 64-core host
+        # must defer, same as a genuinely 1-core box
+        return mode if mode is not None else available_cpus() >= 4
 
     @staticmethod
     def _commit_backlog_budget() -> int:

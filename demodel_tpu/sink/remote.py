@@ -247,7 +247,15 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
     from demodel_tpu.sink.hbm import place_tensor
 
     if prefetch_depth is None:
-        prefetch_depth = env_int("DEMODEL_SINK_PREFETCH", 2, minimum=1)
+        # prefetch overlap needs a SPARE core to run the fetch while the
+        # main thread drives device_put: on a single-CPU host even one
+        # background fetch thread contends (598 vs 238 MB/s at 1 GiB),
+        # so the default there is 0 — fully synchronous, no executor
+        from demodel_tpu.utils.env import available_cpus
+
+        prefetch_depth = env_int(
+            "DEMODEL_SINK_PREFETCH",
+            2 if available_cpus() > 1 else 0, minimum=0)
     out = Placement(mesh_desc=f"{dict(mesh.shape)}")
 
     def fetch(job):
@@ -255,6 +263,31 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
         buf = np.empty(spec.end - spec.start, dtype=np.uint8)
         reader.pread_into(key, buf, spec.start)
         return buf
+
+    def place(buf, name, spec):
+        mv = memoryview(buf)
+        start = spec.start
+
+        def read_at(off, ln, _mv=mv, _s=start):
+            return _mv[off - _s:off - _s + ln]
+
+        np_dtype = _np_dtype(spec.dtype)
+        if name in out.arrays:
+            raise ValueError(f"duplicate tensor across shards: {name}")
+        sharding = plan.sharding_for(name, spec.shape, np_dtype.itemsize)
+        out.arrays[name] = place_tensor(
+            read_at, spec.shape, np_dtype, spec.start, sharding, cast_to)
+
+    if prefetch_depth == 0:
+        # thread-free: fetch inline, place, next — the fastest shape
+        # when there is no core to hide the fetch on
+        for reader, key, name, spec in jobs:
+            try:
+                buf = fetch((reader, key, name, spec))
+            except OSError as e:
+                raise PipelineFailure(e, out) from e
+            place(buf, name, spec)
+        return out
 
     with ThreadPoolExecutor(max_workers=prefetch_depth) as ex:
         pending = [ex.submit(fetch, j)
@@ -272,18 +305,7 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
             nxt = i + prefetch_depth
             if nxt < len(jobs):
                 pending.append(ex.submit(fetch, jobs[nxt]))
-            mv = memoryview(buf)
-            start = spec.start
-
-            def read_at(off, ln, _mv=mv, _s=start):
-                return _mv[off - _s:off - _s + ln]
-
-            np_dtype = _np_dtype(spec.dtype)
-            if name in out.arrays:
-                raise ValueError(f"duplicate tensor across shards: {name}")
-            sharding = plan.sharding_for(name, spec.shape, np_dtype.itemsize)
-            out.arrays[name] = place_tensor(
-                read_at, spec.shape, np_dtype, spec.start, sharding, cast_to)
+            place(buf, name, spec)
     return out
 
 

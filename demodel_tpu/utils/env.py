@@ -45,3 +45,15 @@ def env_bool(name: str, default: bool = False) -> bool:
         return False
     log.warning("%s=%r is not a boolean; using default %s", name, raw, default)
     return default
+
+
+def available_cpus() -> int:
+    """CPUs this process may actually run on — sched_getaffinity sees
+    cgroup/affinity limits (a container pinned to 1 CPU on a 64-core
+    host); cpu_count() is the fallback where affinity is unsupported.
+    Concurrency defaults (peer streams, sink prefetch) clamp to this:
+    extra threads/sockets only help when cores exist to drain them."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1

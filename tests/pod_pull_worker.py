@@ -67,6 +67,21 @@ def _vm_rss_kb() -> int:
     return 0
 
 
+# warm the runtime BEFORE the baseline: XLA's CPU client, per-device
+# buffers, and the collective machinery all allocate lazily on first
+# use — without this, load-dependent lazy-init lands in the pull's
+# delta and the ceiling assertion turns flaky
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec  # noqa: E402
+
+_warm = jax.device_put(
+    np.zeros((8, 64), np.float32),
+    NamedSharding(mesh, PartitionSpec(None, "tp"))
+    if "tp" in mesh.shape else NamedSharding(mesh, PartitionSpec()))
+jax.block_until_ready(_warm)
+jax.block_until_ready(jnp.sum(_warm))
+del _warm
+
 rss_baseline_kb = _vm_rss_kb()
 
 if mode == "tp-expect-fail":

@@ -441,9 +441,11 @@ def test_pod_15_shard_rehearsal(tmp_path):
     shard count (15) has never run even synthetically. Two store-less
     jax.distributed hosts pull a 15-shard / ~126 MB checkpoint off a warm
     peer with discovery failover active (a dead peer heads the list);
-    per-host network bytes are a strict fraction, fingerprints agree, and
-    each host's RSS delta stays within the landed-bytes budget — whole-
-    FILE materialization on top of the landed tensors would breach it."""
+    per-host network bytes are a strict fraction (THE streaming proof:
+    whole-file materialization would fetch the full checkpoint per host
+    and trip it), fingerprints agree, and each host's RSS delta stays
+    bounded — a gross-runaway guard; the payload-proportional RSS bound
+    lives in the 2 GiB bench where payload dwarfs runtime noise."""
     import os
 
     n_shards, rows, cols = 15, 1024, 2048
@@ -502,14 +504,16 @@ def test_pod_15_shard_rehearsal(tmp_path):
         # RSS ceiling, keyed to LANDED bytes: the mesh has a dp axis, so
         # after ICI completion each host HOLDS the full checkpoint (dp
         # replica) even though it FETCHED only ~half (the assertion
-        # above). On the CPU backend "device memory" is host RAM, and a
+        # above). On the CPU backend "device memory" is host RAM and a
         # landed tensor is resident ~twice (numpy landing buffer +
-        # device buffer) — the 2 GiB single-host bench measured 1.77×.
-        # 2.2× landed + 64 MB slack catches runaway buffering (naive
-        # whole-FILE materialization adds another full checkpoint on
-        # top); the strict streaming proof is the network-byte fraction.
+        # device buffer). The slack term absorbs XLA's LOAD-DEPENDENT
+        # lazy arena growth (measured up to ~450 MB under a busy suite
+        # — it dwarfs this deliberately small checkpoint; the payload-
+        # proportional bound is enforced where payload dominates, in
+        # the 2 GiB bench). This ceiling still catches runaway window
+        # buffering, which leaks GBs, not hundreds of MB.
         delta_kb = o["rss_peak_kb"] - o["rss_baseline_kb"]
-        assert delta_kb * 1024 < weight_nbytes * 2.2 + (64 << 20), \
+        assert delta_kb * 1024 < weight_nbytes * 2.2 + (512 << 20), \
             f"host {o['pid']} RSS grew {delta_kb} KB for a " \
             f"{weight_nbytes >> 10} KB checkpoint"
     total = sum(o["network_bytes"] for o in outs)
