@@ -53,18 +53,21 @@ peers = peer_url.split(",")
 # RSS accounting for the scale rehearsal: baseline AFTER jax+mesh init
 # (the runtime's own footprint is not the delivery path's doing), peak at
 # exit — the delta bounds what the pull added (landed shards + buffers).
-# Baseline is CURRENT VmRSS, not ru_maxrss: the high-water mark never
-# decreases, so an early transient would inflate it and make the
-# ceiling assertion vacuous.
-import resource  # noqa: E402
+# Baseline is CURRENT VmRSS (a high-water baseline is vacuous). Peak is
+# VmHWM, NOT ru_maxrss: the rusage counter is inherited across
+# fork+exec on Linux, so a worker spawned by a pytest process that
+# previously peaked at gigabytes would report THAT peak as its own;
+# VmHWM belongs to the mm, which exec replaces.
+def _vm_status_kb(field: str) -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith(field + ":"):
+                return int(line.split()[1])
+    return 0
 
 
 def _vm_rss_kb() -> int:
-    with open("/proc/self/status") as f:
-        for line in f:
-            if line.startswith("VmRSS:"):
-                return int(line.split()[1])
-    return 0
+    return _vm_status_kb("VmRSS")
 
 
 # warm the runtime BEFORE the baseline: XLA's CPU client, per-device
@@ -109,7 +112,7 @@ out = {
     "weight_bytes": report["weight_bytes"],
     "fp": fps,
     "rss_baseline_kb": rss_baseline_kb,
-    "rss_peak_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+    "rss_peak_kb": _vm_status_kb("VmHWM"),
 }
 if not os.environ.get("DEMODEL_POD_SKIP_REP"):
     rep = placed.arrays["replicated.big"]

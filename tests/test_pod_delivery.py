@@ -506,14 +506,17 @@ def test_pod_15_shard_rehearsal(tmp_path):
         # replica) even though it FETCHED only ~half (the assertion
         # above). On the CPU backend "device memory" is host RAM and a
         # landed tensor is resident ~twice (numpy landing buffer +
-        # device buffer). The slack term absorbs XLA's LOAD-DEPENDENT
-        # lazy arena growth (measured up to ~450 MB under a busy suite
-        # — it dwarfs this deliberately small checkpoint; the payload-
-        # proportional bound is enforced where payload dominates, in
-        # the 2 GiB bench). This ceiling still catches runaway window
-        # buffering, which leaks GBs, not hundreds of MB.
+        # device buffer) — the 2 GiB bench measured ~1.9×. Peak comes
+        # from the worker's own VmHWM (ru_maxrss is inherited across
+        # fork+exec, which made this ceiling flaky under a full-suite
+        # parent whose peak was gigabytes); 128 MB of slack covers
+        # XLA arena variance. A whole-file-materialization regression
+        # (+1 checkpoint on top) breaches this.
         delta_kb = o["rss_peak_kb"] - o["rss_baseline_kb"]
-        assert delta_kb * 1024 < weight_nbytes * 2.2 + (512 << 20), \
+        print(f"[rehearsal] host {o['pid']}: rss delta {delta_kb >> 10} MB "
+              f"(baseline {o['rss_baseline_kb'] >> 10} MB, "
+              f"net {o['network_bytes'] >> 20} MB)", file=sys.stderr)
+        assert delta_kb * 1024 < weight_nbytes * 2.2 + (128 << 20), \
             f"host {o['pid']} RSS grew {delta_kb} KB for a " \
             f"{weight_nbytes >> 10} KB checkpoint"
     total = sum(o["network_bytes"] for o in outs)
