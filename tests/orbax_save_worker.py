@@ -12,7 +12,6 @@ Prints one JSON line:
 
 import json
 import os
-import resource
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -33,9 +32,7 @@ n_tensors = int(sys.argv[3])
 mb_per_tensor = int(sys.argv[4])
 
 
-def _maxrss() -> int:
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
-
+from tests.rss_util import reset_hwm, vm_status_bytes  # noqa: E402
 
 elems = mb_per_tensor << 20 >> 2  # f32
 block = np.arange(1 << 18, dtype=np.float32)
@@ -47,9 +44,12 @@ for i in range(n_tensors):
     del a
 jax.block_until_ready(list(state.values()))
 
-rss_before = _maxrss()
+# scope VmHWM to the SAVE: state construction's transients (the 128 MB
+# tile buffer + device copy per tensor) must not be charged to it
+reset_hwm()
+rss_before = vm_status_bytes("VmRSS")
 stats = save_pytree(endpoint, model, state)
-rss_hwm = _maxrss()
+rss_hwm = vm_status_bytes("VmHWM")
 
 print(json.dumps({
     "rss_before": rss_before,

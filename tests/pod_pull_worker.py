@@ -53,21 +53,10 @@ peers = peer_url.split(",")
 # RSS accounting for the scale rehearsal: baseline AFTER jax+mesh init
 # (the runtime's own footprint is not the delivery path's doing), peak at
 # exit — the delta bounds what the pull added (landed shards + buffers).
-# Baseline is CURRENT VmRSS (a high-water baseline is vacuous). Peak is
-# VmHWM, NOT ru_maxrss: the rusage counter is inherited across
-# fork+exec on Linux, so a worker spawned by a pytest process that
-# previously peaked at gigabytes would report THAT peak as its own;
-# VmHWM belongs to the mm, which exec replaces.
-def _vm_status_kb(field: str) -> int:
-    with open("/proc/self/status") as f:
-        for line in f:
-            if line.startswith(field + ":"):
-                return int(line.split()[1])
-    return 0
-
-
-def _vm_rss_kb() -> int:
-    return _vm_status_kb("VmRSS")
+# Baseline is CURRENT VmRSS (a high-water baseline is vacuous); peak is
+# the mm-scoped VmHWM (see tests/rss_util.py for why never ru_maxrss),
+# reset after warmup so runtime init isn't charged to the pull.
+from tests.rss_util import reset_hwm, vm_status_kb  # noqa: E402
 
 
 # warm the runtime BEFORE the baseline: XLA's CPU client, per-device
@@ -85,7 +74,8 @@ jax.block_until_ready(_warm)
 jax.block_until_ready(jnp.sum(_warm))
 del _warm
 
-rss_baseline_kb = _vm_rss_kb()
+reset_hwm()
+rss_baseline_kb = vm_status_kb("VmRSS")
 
 if mode == "tp-expect-fail":
     try:
@@ -112,7 +102,7 @@ out = {
     "weight_bytes": report["weight_bytes"],
     "fp": fps,
     "rss_baseline_kb": rss_baseline_kb,
-    "rss_peak_kb": _vm_status_kb("VmHWM"),
+    "rss_peak_kb": vm_status_kb("VmHWM"),
 }
 if not os.environ.get("DEMODEL_POD_SKIP_REP"):
     rep = placed.arrays["replicated.big"]

@@ -1,0 +1,35 @@
+"""Process-memory readings for worker scripts' bounded-RSS assertions.
+
+Always /proc/self/status (VmRSS / VmHWM), never ``ru_maxrss``: the
+rusage counter survives fork+exec on Linux, so a worker spawned by a
+big-peaked pytest process inherits a peak above anything it does itself
+— baselines start inflated and bounded-RSS assertions turn vacuous or
+flaky. VmRSS/VmHWM belong to this process's mm, which exec replaces.
+"""
+
+from __future__ import annotations
+
+
+def vm_status_kb(field: str) -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith(field + ":"):
+                return int(line.split()[1])
+    return 0
+
+
+def vm_status_bytes(field: str) -> int:
+    return vm_status_kb(field) * 1024
+
+
+def reset_hwm() -> bool:
+    """Reset VmHWM to the current VmRSS (``echo 5 > clear_refs``) so a
+    later VmHWM reading scopes to work done AFTER this call — e.g. a
+    setup phase's transients must not be charged to the phase under
+    measurement. Returns False where unsupported."""
+    try:
+        with open("/proc/self/clear_refs", "w") as f:
+            f.write("5")
+        return True
+    except OSError:
+        return False

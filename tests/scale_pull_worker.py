@@ -33,11 +33,11 @@ from demodel_tpu import delivery  # noqa: E402
 from demodel_tpu.config import ProxyConfig  # noqa: E402
 
 
+from tests.rss_util import vm_status_bytes  # noqa: E402
+
+
 def vm_hwm() -> int:
-    for line in open("/proc/self/status"):
-        if line.startswith("VmHWM:"):
-            return int(line.split()[1]) * 1024
-    return -1
+    return vm_status_bytes("VmHWM")
 
 
 cfg = ProxyConfig(cache_dir=Path(cache_dir), data_dir=Path(cache_dir) / "d")
