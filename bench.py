@@ -348,6 +348,13 @@ def _bench_e2e() -> dict:
         "control_sim_secs": round(control_sim, 3),
         **({"control_real_secs": round(control_real, 3)}
            if control_real is not None else {}),
+        # sharded-leg phase split (fetch vs device-place vs final block):
+        # the network-bound / transfer-bound diagnosis for slow pulls —
+        # on a tunneled backend these differ by 10× and name the culprit
+        **({"sharded_phase_secs": report_sh["phase_secs"]}
+           if report_sh.get("phase_secs") else {}),
+        **({"sharded_block_secs": report_sh["block_secs"]}
+           if report_sh.get("block_secs") is not None else {}),
         # north-star projection: BASELINE.md's Llama-2-7B is ~13 GB —
         # the <30s cold-pull→HBM goal at this run's measured rate
         "projected_13gb_s": round(13000 / (mb / ours), 1),

@@ -25,12 +25,25 @@ from jax.experimental import pallas as pl
 
 from demodel_tpu.formats import gguf
 
-#: blocks per pallas grid step (Q4_0/Q8_0: 32-elem blocks → 256-elem tiles)
-Q_TILE = 8
+#: quant blocks (rows) per pallas grid step for Q4_0/Q8_0. 256 rows keeps
+#: every operand Mosaic-tileable: sublane tiling is 8 (f32 scales), 16
+#: (bf16 out) and 32 (int8 payload), and 256 is a multiple of all three —
+#: the old rank-1 (8,)-row blocks failed Mosaic's rank-1 tiling check on
+#: the first real-chip compile (round 5)
+Q_TILE = 256
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _force_pallas() -> bool:
+    """DEMODEL_FORCE_PALLAS=1 pins the pallas path regardless of backend
+    (the kernel parity tests set it; interpret mode executes the grid in
+    Python)."""
+    import os
+
+    return os.environ.get("DEMODEL_FORCE_PALLAS", "").strip() == "1"
 
 
 def _use_pallas() -> bool:
@@ -38,13 +51,8 @@ def _use_pallas() -> bool:
     executes the grid step-by-step in Python — measured 267 s for ONE
     8M-element Q8_0 tensor on this host, vs <1 s for the identical
     `_math` jnp — so off-TPU delivery takes the math path and the kernels
-    stay covered by the dedicated kernel tests (DEMODEL_FORCE_PALLAS=1
-    pins the pallas path regardless, which is what those tests set)."""
-    import os
-
-    if os.environ.get("DEMODEL_FORCE_PALLAS", "").strip() == "1":
-        return True
-    return jax.default_backend() == "tpu"
+    stay covered by the dedicated kernel tests."""
+    return _force_pallas() or jax.default_backend() == "tpu"
 
 
 # --------------------------------------------------------------- Q8_0/Q4_0
@@ -56,24 +64,37 @@ def _q8_0_math(d, qs, out_dtype):
 
 
 def _q8_0_kernel(d_ref, qs_ref, o_ref, *, out_dtype):
-    o_ref[...] = _q8_0_math(d_ref[...], qs_ref[...], out_dtype)
+    # d block is (R, 1) f32 — broadcasts across the 32 lane columns
+    o_ref[...] = (d_ref[...] * qs_ref[...].astype(jnp.float32)).astype(
+        out_dtype)
+
+
+def _pad_rows(x, nbp: int):
+    nb = x.shape[0]
+    if nbp == nb:
+        return jnp.asarray(x)
+    widths = [(0, nbp - nb)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(jnp.asarray(x), widths)
 
 
 def dequant_q8_0(d, qs, out_dtype=jnp.bfloat16):
     """d: (nb,) f16, qs: (nb, 32) i8 → flat (nb*32,) out_dtype."""
     nb = d.shape[0]
-    if nb % Q_TILE != 0 or not _use_pallas():
+    if nb == 0 or not _use_pallas():
         return _q8_0_math(jnp.asarray(d), jnp.asarray(qs), out_dtype).reshape(-1)
+    nbp = -(-nb // Q_TILE) * Q_TILE  # pad the row tail; sliced off below
+    dp = _pad_rows(jnp.asarray(d).astype(jnp.float32), nbp).reshape(nbp, 1)
+    qsp = _pad_rows(qs, nbp)
     out = pl.pallas_call(
         functools.partial(_q8_0_kernel, out_dtype=out_dtype),
-        grid=(nb // Q_TILE,),
-        in_specs=[pl.BlockSpec((Q_TILE,), lambda i: (i,)),
+        grid=(nbp // Q_TILE,),
+        in_specs=[pl.BlockSpec((Q_TILE, 1), lambda i: (i, 0)),
                   pl.BlockSpec((Q_TILE, gguf.QK), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((Q_TILE, gguf.QK), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, gguf.QK), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((nbp, gguf.QK), out_dtype),
         interpret=_interpret(),
-    )(d, qs)
-    return out.reshape(-1)
+    )(dp, qsp)
+    return out.reshape(-1)[:nb * gguf.QK]
 
 
 def _q4_0_math(d, qs, out_dtype):
@@ -85,24 +106,31 @@ def _q4_0_math(d, qs, out_dtype):
 
 
 def _q4_0_kernel(d_ref, qs_ref, o_ref, *, out_dtype):
-    o_ref[...] = _q4_0_math(d_ref[...], qs_ref[...], out_dtype)
+    qs = qs_ref[...].astype(jnp.int32)
+    lo = (qs & 0xF) - 8
+    hi = (qs >> 4) - 8
+    q = jnp.concatenate([lo, hi], axis=-1).astype(jnp.float32)
+    o_ref[...] = (d_ref[...] * q).astype(out_dtype)
 
 
 def dequant_q4_0(d, qs, out_dtype=jnp.bfloat16):
     """d: (nb,) f16, qs: (nb, 16) u8 → flat (nb*32,) out_dtype."""
     nb = d.shape[0]
-    if nb % Q_TILE != 0 or not _use_pallas():
+    if nb == 0 or not _use_pallas():
         return _q4_0_math(jnp.asarray(d), jnp.asarray(qs), out_dtype).reshape(-1)
+    nbp = -(-nb // Q_TILE) * Q_TILE
+    dp = _pad_rows(jnp.asarray(d).astype(jnp.float32), nbp).reshape(nbp, 1)
+    qsp = _pad_rows(qs, nbp)
     out = pl.pallas_call(
         functools.partial(_q4_0_kernel, out_dtype=out_dtype),
-        grid=(nb // Q_TILE,),
-        in_specs=[pl.BlockSpec((Q_TILE,), lambda i: (i,)),
+        grid=(nbp // Q_TILE,),
+        in_specs=[pl.BlockSpec((Q_TILE, 1), lambda i: (i, 0)),
                   pl.BlockSpec((Q_TILE, gguf.QK // 2), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((Q_TILE, gguf.QK), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, gguf.QK), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((nbp, gguf.QK), out_dtype),
         interpret=_interpret(),
-    )(d, qs)
-    return out.reshape(-1)
+    )(dp, qsp)
+    return out.reshape(-1)[:nb * gguf.QK]
 
 
 # ----------------------------------------------------------------- K-quants
@@ -260,12 +288,19 @@ def _q6_k_math(d, sc, ql, qh, out_dtype):
 
 def _k_quant_call(math_fn, parts, out_dtype, part_widths):
     """Run a K-quant math fn as a pallas kernel, one super-block per grid
-    step (any block count tiles at 1), falling back to plain jnp when the
-    interpreter would just add overhead for tiny inputs."""
+    step, or as plain fused jnp.
+
+    On REAL TPU the math path is used: K-quant bit-unpacking is
+    lane-hostile (1-wide sublane blocks, 12/16-byte operands, rank-1
+    scale vectors) and the one-super-block-per-step kernel layout does
+    not satisfy Mosaic's tiling rules — the fused XLA elementwise graph
+    is the right tool for this bandwidth-bound transform. The kernels
+    remain exercised under DEMODEL_FORCE_PALLAS (interpret-mode kernel
+    tests), keeping the math/kernel parity oracle alive."""
     nb = parts[0].shape[0]
     if nb == 0:
         return jnp.zeros((0,), out_dtype)
-    if not _use_pallas():
+    if not _force_pallas():
         return math_fn(*parts, out_dtype).reshape(-1)
 
     def kernel(*refs):

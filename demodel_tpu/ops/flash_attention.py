@@ -108,11 +108,44 @@ def reference_attention(q, k, v, causal: bool = True, scale=None,
 # ----------------------------------------------------------------- kernel
 
 
-def _flash_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                  acc_ref, m_ref, l_ref, *, scale, causal, block_q,
-                  block_k):
-    """One (b, h, qi, ki) step. Scratch (acc, m, l) persists across the
-    minor-most ki dimension; init at ki==0, finalize at the last ki."""
+#: TPU lane width — the m/l running stats live lane-replicated at this
+#: width (the layout Mosaic lowers without relayout ops; matching the
+#: convention of jax's own pallas TPU flash kernel, which this kernel's
+#: earlier (B,S,H,D)-blocked layout violated: a block of 1 over the
+#: 8-wide H dim sat in the sublane slot and failed Mosaic's tiling check
+#: on real silicon — first on-chip compile, round 5)
+_LANES = 128
+#: lane width of the lse HBM buffer — the kernel's (block_q, 128) stats
+#: are lane-sliced to this on the store; consumers read lane 0. Kept > 1
+#: only so the store stays a plain slice (no cross-lane reduce)
+_LSE_LANES = 8
+
+
+def _lanes(x, n: int):
+    """(block_q, 128) lane-replicated stat → (block_q, n) for combining
+    with an n-lane tile (n ≤ 128 slices; multiples of 128 tile; other
+    widths — e.g. D=192 heads — broadcast from one lane)."""
+    if n <= _LANES:
+        return x[:, :n]
+    reps, rem = divmod(n, _LANES)
+    if rem == 0:
+        return jnp.tile(x, (1, reps)) if reps > 1 else x
+    return jnp.broadcast_to(x[:, :1], (x.shape[0], n))
+
+
+def _flash_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, *rest,
+                  scale, causal, block_q, block_k, with_lse):
+    """One (b, h, qi, ki) step over (B, H, S, D)-laid-out tiles. Scratch
+    (acc, m, l) persists across the minor-most ki dimension; init at
+    ki==0, finalize at the last ki. m/l are (block_q, 128) with the stat
+    replicated across lanes. The lse output (and its 128-lane HBM
+    buffer) exists only when requested — the plain forward path skips
+    it entirely."""
+    if with_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        lse_ref = None
+        acc_ref, m_ref, l_ref = rest
     ki = pl.program_id(3)
     qi = pl.program_id(2)
     nk = pl.num_programs(3)
@@ -135,10 +168,12 @@ def _flash_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
-        k = k_ref[0, :, 0, :].astype(jnp.float32)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
-        s = q @ k.T  # (block_q, block_k) on the MXU
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (block_q, block_k), MXU
 
         q_idx = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
@@ -149,29 +184,35 @@ def _flash_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             mask &= k_idx <= q_idx + offset
         s = jnp.where(mask, s, NEG_INF)
 
-        m_prev = m_ref[:, 0]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1))
-        alpha = jnp.exp(m_prev - m_new)
+        m_prev = m_ref[...]                       # (block_q, 128)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1)[:, None])
+        alpha = jnp.exp(m_prev - m_new)           # (block_q, 128)
         # zero masked entries explicitly: a row with NO visible key in a
         # live block has every s == NEG_INF, so m_new == NEG_INF and
         # exp(s - m_new) == 1 for all entries — without this, l would
         # accumulate block_k and the finalize's l==0 guard never fires
         # (the output would silently become mean(V) instead of zeros)
-        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
-        l_ref[:, 0] = l_ref[:, 0] * alpha + p.sum(axis=-1)
-        acc_ref[...] = acc_ref[...] * alpha[:, None] + p @ v
-        m_ref[:, 0] = m_new
+        p = jnp.where(mask, jnp.exp(s - _lanes(m_new, block_k)), 0.0)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)[:, None]
+        D = acc_ref.shape[-1]
+        acc_ref[...] = acc_ref[...] * _lanes(alpha, D) + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
 
     @pl.when(ki == nk - 1)
     def _finalize():
         # fully-masked rows (past-Sq padding / no visible keys) have
         # l == 0 — emit zeros and an lse of NEG_INF (combines as "no
         # contribution" in the ring's log-space merge)
-        l = l_ref[:, 0]
+        l = l_ref[...]                            # (block_q, 128)
         safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, :, 0, :] = (acc_ref[...] / safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0, :, 0] = jnp.where(
-            l > 0.0, m_ref[:, 0] + jnp.log(safe), NEG_INF)
+        D = acc_ref.shape[-1]
+        o_ref[0, 0, :, :] = (acc_ref[...] * _lanes(1.0 / safe, D)).astype(
+            o_ref.dtype)
+        if with_lse:
+            lse_ref[0, 0, :, :] = jnp.where(
+                l > 0.0, m_ref[...] + jnp.log(safe),
+                NEG_INF)[:, :_LSE_LANES]
 
 
 def _pad_to(x, axis: int, multiple: int):
@@ -185,7 +226,7 @@ def _pad_to(x, axis: int, multiple: int):
 
 
 def _flash_forward(q, k, v, kv_len, causal_offset, causal, scale, block_q,
-                   block_k):
+                   block_k, with_lse=True):
     B, Sq, H, D = q.shape
     Sk, G = k.shape[1], k.shape[2]
     if H % G != 0:
@@ -215,60 +256,90 @@ def _flash_forward(q, k, v, kv_len, causal_offset, causal, scale, block_q,
         jnp.stack([kvb, offb], axis=1).reshape(-1),
     ])
 
-    out, lse = pl.pallas_call(
+    # kernel layout is (B, H, S, D): heads become a pure grid dimension
+    # and the last two block dims (seq block, D) are the MXU-tiled pair —
+    # the layout Mosaic accepts (H in the sublane slot is rejected on
+    # real TPU). The transposes are HBM copies XLA fuses with adjacent
+    # ops; the einsum path's S² score tensor still dwarfs them.
+    qt = qp.transpose(0, 2, 1, 3)
+    kt = kp.transpose(0, 2, 1, 3)
+    vt = vp.transpose(0, 2, 1, 3)
+    Sqp = qp.shape[1]
+    out_specs = [
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, h, qi, ki: (b, h, qi, 0)),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((B, H, Sqp, D), q.dtype)]
+    if with_lse:
+        # lse rides lane-replicated at width _LSE_LANES (8): the minor
+        # block dim spans the full array dim, which Mosaic accepts at
+        # any size — 16× leaner than mirroring the kernel's 128-lane
+        # stats into HBM (the jax reference kernel's choice), and the
+        # store is a cheap lane-slice of those stats. Only allocated
+        # when a caller (the ring merge) actually consumes it — the
+        # plain forward must not pay it at all.
+        out_specs.append(pl.BlockSpec((1, 1, block_q, _LSE_LANES),
+                                      lambda b, h, qi, ki: (b, h, qi, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B, H, Sqp, _LSE_LANES), jnp.float32))
+    res = pl.pallas_call(
         functools.partial(
             _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k),
+            block_k=block_k, with_lse=with_lse),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, 1, D),
-                         lambda b, h, qi, ki: (b, qi, h, 0)),
-            pl.BlockSpec((1, block_k, 1, D),
-                         lambda b, h, qi, ki: (b, ki, h // q_per_kv, 0)),
-            pl.BlockSpec((1, block_k, 1, D),
-                         lambda b, h, qi, ki: (b, ki, h // q_per_kv, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h // q_per_kv, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h // q_per_kv, ki, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, 1, D),
-                         lambda b, h, qi, ki: (b, qi, h, 0)),
-            pl.BlockSpec((1, block_q, 1),
-                         lambda b, h, qi, ki: (b, qi, h)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(qp.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, qp.shape[1], H), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
-            pltpu.VMEM((block_q, D), jnp.float32),   # acc
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom l
+            pltpu.VMEM((block_q, D), jnp.float32),       # acc
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom l
         ],
         interpret=_interpret(),
-    )(scalars, qp, kp, vp)
-    return out[:, :Sq], lse[:, :Sq]
+    )(scalars, qt, kt, vt)
+    if with_lse:
+        out, lse = res
+        return (out[:, :, :Sq].transpose(0, 2, 1, 3),
+                lse[:, :, :Sq, 0].transpose(0, 2, 1))
+    return res[0][:, :, :Sq].transpose(0, 2, 1, 3), None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def _flash_core(q, k, v, kv_len, causal_offset, causal, scale, block_q,
-                block_k):
+                block_k, with_lse):
     return _flash_forward(q, k, v, kv_len, causal_offset, causal, scale,
-                          block_q, block_k)
+                          block_q, block_k, with_lse)
 
 
-def _fwd(q, k, v, kv_len, causal_offset, causal, scale, block_q, block_k):
+def _fwd(q, k, v, kv_len, causal_offset, causal, scale, block_q, block_k,
+         with_lse):
     out = _flash_forward(q, k, v, kv_len, causal_offset, causal, scale,
-                         block_q, block_k)
+                         block_q, block_k, with_lse)
     return out, (q, k, v, kv_len, causal_offset)
 
 
-def _bwd(causal, scale, block_q, block_k, res, g):
+def _bwd(causal, scale, block_q, block_k, with_lse, res, g):
     q, k, v, kv_len, causal_offset = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention_lse(
-            q_, k_, v_, causal, scale, kv_len=kv_len,
-            causal_offset=causal_offset),
-        q, k, v)
+    if with_lse:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: reference_attention_lse(
+                q_, k_, v_, causal, scale, kv_len=kv_len,
+                causal_offset=causal_offset),
+            q, k, v)
+    else:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: (reference_attention(
+                q_, k_, v_, causal, scale, kv_len=kv_len,
+                causal_offset=causal_offset), None),
+            q, k, v)
     dq, dk, dv = vjp(g)
 
     def _zero_int(x):
@@ -292,5 +363,5 @@ def flash_attention(q, k, v, kv_len=None, causal: bool = True, scale=None,
     (query i sees keys ≤ i+offset); it defaults to ``kv_len - Sq``,
     aligning the LAST query with the last valid key."""
     out, lse = _flash_core(q, k, v, kv_len, causal_offset, causal, scale,
-                           block_q, block_k)
+                           block_q, block_k, return_lse)
     return (out, lse) if return_lse else out

@@ -52,6 +52,10 @@ class Placement:
     #: exception the finalizer itself died with (e.g. the manifest write
     #: failed) — re-raised by :meth:`finalize`
     finalize_error: object = None
+    #: delivery phase wall-clock split (``fetch_secs``/``place_secs``, or
+    #: ``fetch_stall_secs`` under prefetch overlap) set by the pipelined
+    #: sharded path — the network-bound vs device-transfer-bound diagnosis
+    phase_secs: dict | None = None
 
     @property
     def total_bytes(self) -> int:

@@ -247,15 +247,25 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
     from demodel_tpu.sink.hbm import place_tensor
 
     if prefetch_depth is None:
-        # prefetch overlap needs a SPARE core to run the fetch while the
-        # main thread drives device_put: on a single-CPU host even one
-        # background fetch thread contends (598 vs 238 MB/s at 1 GiB),
-        # so the default there is 0 — fully synchronous, no executor
+        # prefetch overlap needs either a SPARE core or a transfer that
+        # leaves the core: on a single-CPU host with the CPU backend,
+        # "device_put" is a memcpy on the same core and even one
+        # background fetch thread contends (598 vs 238 MB/s at 1 GiB) —
+        # default 0, fully synchronous. On a REAL TPU the host→device
+        # transfer runs in the runtime off the GIL, so one fetch thread
+        # overlaps it even on one core; multi-core keeps depth 2.
+        import jax as _jax
+
         from demodel_tpu.utils.env import available_cpus
 
+        if available_cpus() > 1:
+            default_depth = 2
+        elif _jax.default_backend() == "tpu":
+            default_depth = 1
+        else:
+            default_depth = 0
         prefetch_depth = env_int(
-            "DEMODEL_SINK_PREFETCH",
-            2 if available_cpus() > 1 else 0, minimum=0)
+            "DEMODEL_SINK_PREFETCH", default_depth, minimum=0)
     out = Placement(mesh_desc=f"{dict(mesh.shape)}")
 
     def fetch(job):
@@ -278,21 +288,38 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
         out.arrays[name] = place_tensor(
             read_at, spec.shape, np_dtype, spec.start, sharding, cast_to)
 
+    # phase accounting (exposed via the pull report): fetch wall vs
+    # place wall tells whether a slow pull is network-bound or
+    # device-transfer-bound — on a tunneled single-chip backend the two
+    # differ by an order of magnitude and the split is the diagnosis.
+    # Under prefetch overlap the first key is the EXPOSED stall on the
+    # next buffer (overlapped network time hides inside place), so it is
+    # named fetch_stall_secs there, not fetch_secs.
+    fetch_key = "fetch_secs" if prefetch_depth == 0 else "fetch_stall_secs"
+    phases = {fetch_key: 0.0, "place_secs": 0.0}
+    out.phase_secs = phases
+
     if prefetch_depth == 0:
         # thread-free: fetch inline, place, next — the fastest shape
         # when there is no core to hide the fetch on
         for reader, key, name, spec in jobs:
+            t0 = time.perf_counter()
             try:
                 buf = fetch((reader, key, name, spec))
             except OSError as e:
                 raise PipelineFailure(e, out) from e
+            t1 = time.perf_counter()
             place(buf, name, spec)
+            t2 = time.perf_counter()
+            phases[fetch_key] += t1 - t0
+            phases["place_secs"] += t2 - t1
         return out
 
     with ThreadPoolExecutor(max_workers=prefetch_depth) as ex:
         pending = [ex.submit(fetch, j)
                    for j in jobs[:prefetch_depth]]
         for i, (reader, key, name, spec) in enumerate(jobs):
+            t0 = time.perf_counter()
             try:
                 buf = pending.pop(0).result()
             except OSError as e:
@@ -302,10 +329,13 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
                 for p in pending:
                     p.cancel()
                 raise PipelineFailure(e, out) from e
+            t1 = time.perf_counter()
             nxt = i + prefetch_depth
             if nxt < len(jobs):
                 pending.append(ex.submit(fetch, jobs[nxt]))
             place(buf, name, spec)
+            phases[fetch_key] += t1 - t0
+            phases["place_secs"] += time.perf_counter() - t1
     return out
 
 
@@ -429,8 +459,10 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
                 file_tensors[f["key"]] = set(index.tensors)
                 for tname, spec in index.tensors.items():
                     jobs.append((reader, f["key"], tname, spec))
-            merge_placement(placement, _deliver_jobs_pipelined(
-                jobs, mesh, plan, cast_to=cast_to))
+            delivered = _deliver_jobs_pipelined(
+                jobs, mesh, plan, cast_to=cast_to)
+            merge_placement(placement, delivered)
+            report["phase_secs"] = delivered.phase_secs
             report["weight_bytes"] += sum(int(f["size"])
                                           for f in weight_files)
             pipelined = True
@@ -441,6 +473,9 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
             # — a flaky window at shard 14 of 15 costs the remaining
             # windows, not a full redo of the device transfers
             merge_placement(placement, e.partial)
+            # the phase split for what DID land — the flaky-pull case is
+            # exactly where the fetch/place diagnosis matters most
+            report["phase_secs"] = e.partial.phase_secs
             resume_skip = set(e.partial.arrays)
             log.warning("pipelined delivery failed (%s); %d tensors "
                         "landed — resuming the rest with per-file "
@@ -494,7 +529,9 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
                 raise IOError(f"no peer could serve {name}") from last_err
             merge_placement(placement, placed)
             report["weight_bytes"] += size
+    t_block = time.perf_counter()
     jax.block_until_ready(list(placement.arrays.values()))
+    report["block_secs"] = round(time.perf_counter() - t_block, 3)
     report["network_bytes"] = sum(r.bytes_fetched for r in readers)
     report["secs"] = round(time.perf_counter() - t0, 3)
     log.info("pod-placed %d tensors (%.1f MB weights) from %s: this host "

@@ -67,6 +67,18 @@ def _flash_child() -> None:
     err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
     ok = bool(err < 0.1 and np.isfinite(err))
 
+    # the lse output path (the ring-attention consumer) lowers through a
+    # different out_spec — validate it on-chip too, not just the plain
+    # forward
+    from demodel_tpu.ops.flash_attention import reference_attention_lse
+
+    out2, lse = flash_attention(q, k, v, causal=True, return_lse=True)
+    _, ref_lse = reference_attention_lse(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32), causal=True)
+    lse_err = float(jnp.max(jnp.abs(lse - ref_lse)))
+    ok = ok and bool(lse_err < 0.05 and np.isfinite(lse_err))
+
     # dequant kernels (ops/dequant.py) share the on-chip gate: same
     # Mosaic-lowering risk, same record. Oracle = the jnp math path the
     # kernels wrap (the CPU-delivery fallback, parity-tested in-suite).
@@ -91,6 +103,7 @@ def _flash_child() -> None:
            "compile_s": round(compile_s, 1),
            "run_s": round(run_s, 4),
            "max_err_vs_ref": err,
+           "lse_max_err": lse_err,
            "dequant_max_err": {"q8_0": err8, "q4_0": err4},
            "backend": jax.default_backend(),
            "device": str(jax.devices()[0]),
