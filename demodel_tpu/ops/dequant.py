@@ -2,12 +2,18 @@
 
 The HBM sink ships the *quantized* payload over the host→device link and
 widens on device (SURVEY.md §2.3 "Sharded HBM placement"): for Q8_0 that is
-a 3.8× link saving over shipping f32. Each format has
+a 3.8× link saving over shipping f32. Dispatch per format:
 
-- a pallas kernel gridded over block tiles (TPU path; interpreted on CPU
-  test meshes), used when the block count tiles evenly;
-- a pure-jnp fallback (odd block counts, exotic shapes) — same math, XLA
-  fused, numerically identical.
+- **Q8_0 / Q4_0**: a pallas kernel over 256-row 2-D tiles (any block
+  count — row tails are padded and sliced off) on real TPU; pure-jnp
+  math off-TPU (the interpreter executes grids in Python — minutes per
+  tensor).
+- **K-quants (Q2_K…Q6_K)**: always the fused-jnp math path at runtime —
+  the bit-unpacking layouts (12/16-byte operands, rank-1 scale vectors)
+  are lane-hostile and their one-super-block kernels do not satisfy
+  Mosaic's tiling rules on real TPU; XLA's fused elementwise graph is
+  the right tool for this bandwidth-bound transform. The kernels remain
+  as an interpret-only parity oracle under DEMODEL_FORCE_PALLAS.
 
 Bit layouts follow the llama.cpp/ggml block spec; the numpy decoders in
 :mod:`demodel_tpu.formats.gguf` (``REF_DEQUANT``) are the normative
@@ -319,7 +325,10 @@ def _k_quant_call(math_fn, parts, out_dtype, part_widths):
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, gguf.QK_K), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((nb, gguf.QK_K), out_dtype),
-        interpret=_interpret(),
+        # ALWAYS interpreted: this one-super-block layout is exactly
+        # what Mosaic rejects on real TPU (round-5 on-chip compile), so
+        # a forced run on a TPU host must not hand it to the compiler
+        interpret=True,
     )(*parts)
     return out.reshape(-1)
 
