@@ -41,20 +41,28 @@ def _env_flag(name: str) -> bool | None:
     return None
 
 
+def _load_record() -> dict:
+    """The committed on-chip record, or {} when absent/unreadable."""
+    try:
+        return json.loads(ONCHIP_RECORD.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
 def flash_validated_on_chip() -> bool:
     """True when a committed on-chip parity record says the kernels
     compiled under Mosaic and matched the einsum oracle on real TPU."""
-    try:
-        rec = json.loads(ONCHIP_RECORD.read_text())
-    except (OSError, ValueError):
-        return False
-    return bool(rec.get("ok"))
+    return bool(_load_record().get("ok"))
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
 
 
 def _default_on() -> bool:
-    import jax
-
-    return jax.default_backend() == "tpu" and flash_validated_on_chip()
+    return _on_tpu() and flash_validated_on_chip()
 
 
 def use_flash_attention() -> bool:
@@ -65,9 +73,18 @@ def use_flash_attention() -> bool:
     return _default_on()
 
 
+def _ring_validated_on_chip() -> bool:
+    """The ring path compiles the flash kernel INSIDE shard_map (per-step
+    tiles + log-space merge) — a distinct lowering from the plain
+    forward, validated separately. Older records without the field fall
+    back to the overall ok (pre-split behavior)."""
+    rec = _load_record()
+    return bool(rec.get("ring_ok", rec.get("ok")))
+
+
 def use_flash_ring() -> bool:
     """Should ring attention compute each step with the fused kernel?"""
     env = _env_flag("DEMODEL_FLASH_RING")
     if env is not None:
         return env
-    return _default_on()
+    return _on_tpu() and _ring_validated_on_chip()

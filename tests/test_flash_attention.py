@@ -225,15 +225,19 @@ def test_flash_default_policy(monkeypatch, tmp_path):
     monkeypatch.setattr(fd, "ONCHIP_RECORD", rec)
     assert fd.use_flash_attention() is False  # backend is cpu here
     # TPU backend + record → on by default; env=0 still wins
-    monkeypatch.setattr(fd, "_default_on",
-                        lambda: fd.flash_validated_on_chip())
+    monkeypatch.setattr(fd, "_on_tpu", lambda: True)
     assert fd.use_flash_attention() is True
-    assert fd.use_flash_ring() is True
+    assert fd.use_flash_ring() is True  # pre-split record: falls back to ok
     monkeypatch.setenv("DEMODEL_FLASH_RING", "0")
+    assert fd.use_flash_ring() is False
+    monkeypatch.delenv("DEMODEL_FLASH_RING")
+    # ring_ok is a SEPARATE gate: a ring-specific on-chip failure keeps
+    # the ring default off while the plain forward still flips
+    rec.write_text(_json.dumps({"ok": True, "ring_ok": False}))
+    assert fd.use_flash_attention() is True
     assert fd.use_flash_ring() is False
     # a failed on-chip record must NOT flip defaults
     rec.write_text(_json.dumps({"ok": False, "error": "mosaic"}))
-    monkeypatch.delenv("DEMODEL_FLASH_RING")
     assert fd.use_flash_attention() is False
 
 

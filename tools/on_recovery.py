@@ -79,6 +79,32 @@ def _flash_child() -> None:
     lse_err = float(jnp.max(jnp.abs(lse - ref_lse)))
     ok = ok and bool(lse_err < 0.05 and np.isfinite(lse_err))
 
+    # ring attention compiles the flash tiles INSIDE shard_map (the
+    # long-context flagship) — its own lowering, its own record flag, so
+    # a ring-specific failure doesn't block the plain-forward flip
+    # ring size = every chip present (ONE in this environment — the
+    # multi-step rotation semantics are covered by the 8-device CPU
+    # interpret suite; what only silicon can validate is the kernel's
+    # Mosaic lowering inside shard_map, which is per-device identical at
+    # any ring size). ring_devices in the record says what actually ran.
+    ring_ok, ring_err = False, None
+    ring_devices = jax.device_count()
+    try:
+        from demodel_tpu.ops.ring_attention import ring_attention_sharded
+        from demodel_tpu.parallel.mesh import make_mesh
+
+        os.environ["DEMODEL_FLASH_RING"] = "1"
+        mesh = make_mesh(sp=ring_devices)
+        r_out = ring_attention_sharded(q, k, v, mesh, axis="sp",
+                                       causal=True)
+        ring_err = float(jnp.max(jnp.abs(
+            r_out.astype(jnp.float32) - ref)))
+        ring_ok = bool(ring_err < 0.1 and np.isfinite(ring_err))
+    except Exception as e:  # noqa: BLE001 — record the failure, don't die
+        ring_err = f"{type(e).__name__}: {e}"[:300]
+    finally:
+        os.environ.pop("DEMODEL_FLASH_RING", None)
+
     # dequant kernels (ops/dequant.py) share the on-chip gate: same
     # Mosaic-lowering risk, same record. Oracle = the jnp math path the
     # kernels wrap (the CPU-delivery fallback, parity-tested in-suite).
@@ -104,6 +130,9 @@ def _flash_child() -> None:
            "run_s": round(run_s, 4),
            "max_err_vs_ref": err,
            "lse_max_err": lse_err,
+           "ring_ok": ring_ok,
+           "ring_err": ring_err,
+           "ring_devices": ring_devices,
            "dequant_max_err": {"q8_0": err8, "q4_0": err4},
            "backend": jax.default_backend(),
            "device": str(jax.devices()[0]),
