@@ -165,7 +165,16 @@ def main() -> int:
                     + "\n")
     except subprocess.TimeoutExpired:
         print('{"flash_on_chip": false, "error": "timeout"}')
-    print("[recovery] step 2b: decode throughput before/after flash",
+    # bench reps BEFORE the decode leg: the scoreboard metric and the
+    # kernel record are the round's deliverables, and live windows have
+    # died at ~45 min — decode (two compiles + possible retries) must
+    # not eat the reps' slot
+    print("[recovery] step 3: two spaced bench reps", file=sys.stderr)
+    for _ in range(2):
+        time.sleep(120)  # cool-down: the tunnel wedges under abuse
+        subprocess.run([sys.executable, str(REPO / "tools/bench_series.py"),
+                        "1"], timeout=1800)
+    print("[recovery] step 4: decode throughput before/after flash",
           file=sys.stderr)
     try:
         r = subprocess.run([sys.executable,
@@ -184,11 +193,6 @@ def main() -> int:
         print(rec)
     with open(REPO / "BENCH_SERIES_r05.jsonl", "a") as f:
         f.write(rec + "\n")
-    print("[recovery] step 3: two spaced bench reps", file=sys.stderr)
-    for _ in range(2):
-        time.sleep(120)  # cool-down: the tunnel wedges under abuse
-        subprocess.run([sys.executable, str(REPO / "tools/bench_series.py"),
-                        "1"], timeout=1800)
     return 0
 
 
