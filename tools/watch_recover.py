@@ -17,7 +17,10 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 MARKER = REPO / ".recovery_fired_r05"
-PERIOD = 600
+# windows have died at ~45 min and a wedged probe burns its 180 s
+# timeout anyway — a 300 s sleep gives ~8 min discovery latency
+# (vs ~13 min at 600 s), recovering ~10% of a typical window
+PERIOD = 300
 
 
 def probe_once(timeout: int = 180) -> dict:
