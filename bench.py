@@ -145,6 +145,24 @@ def _bench_e2e() -> dict:
                 jax.block_until_ready(
                     jax.device_put(np.zeros((256, 4096), _md.bfloat16))
                 )
+                import ml_dtypes as _md2  # local name for the probe below
+
+                def _link_probe() -> float:
+                    """Raw host→device rate for one 64 MB device_put.
+                    Called AFTER both delivery legs — by then the tunnel
+                    burst buffer has drained through 2× checkpoint bytes,
+                    so this reads the SUSTAINED channel rate the bulk of
+                    a large transfer faces (probing before the legs would
+                    both steal the headline leg's burst headroom and
+                    report the burst rate, inverting the diagnosis)."""
+                    probe = np.zeros((8192, 4096), _md2.bfloat16)
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(jax.device_put(probe))
+                    rate = round(
+                        probe.nbytes / 1e6 / (time.perf_counter() - t0), 1)
+                    print(f"[bench] sustained link probe: {rate} MB/s "
+                          "host→device", file=sys.stderr)
+                    return rate
 
                 # ---- ours: cold node, warm peer → HBM, best of two
                 # strategies (both legitimate cold pulls):
@@ -238,6 +256,7 @@ def _bench_e2e() -> dict:
                 # is the flagship path; DEMODEL_BENCH_STRATEGY=file
                 # headlines whole-file instead.
                 ours = ours_file if strategy == "file" else ours_sharded
+                link_mbps = _link_probe()
                 print(f"[bench] ours: whole-file={ours_file:.3f}s "
                       f"sharded={ours_sharded:.3f}s → headline strategy: "
                       f"{strategy}", file=sys.stderr)
@@ -362,6 +381,7 @@ def _bench_e2e() -> dict:
         "whole_file_mbps": round(mb / ours_file, 2),
         "sharded_mbps": round(mb / ours_sharded, 2),
         "rss_delta_mb": rss_delta_mb,
+        "link_sustained_mbps": link_mbps,
         # which control stack vs_baseline came from, + both on record
         "control": "real-hf-cli" if control_real is not None else "sim",
         "control_sim_secs": round(control_sim, 3),
