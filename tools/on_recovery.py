@@ -193,6 +193,27 @@ def main() -> int:
         print(rec)
     with open(REPO / "BENCH_SERIES_r05.jsonl", "a") as f:
         f.write(rec + "\n")
+
+    # commit the captured artifacts (narrow pathspec: never sweeps
+    # unrelated work-in-progress into an automated commit) — a window
+    # that opens and closes unattended must still leave its evidence in
+    # history
+    try:
+        artifacts = [p for p in (
+            "BENCH_SERIES_r05.jsonl", "TUNNEL_LOG.jsonl",
+            "demodel_tpu/ops/_flash_onchip_validated.json",
+            ".recovery_fired_r05") if (REPO / p).exists()]
+        subprocess.run(["git", "add", *artifacts], cwd=REPO, timeout=60)
+        r = subprocess.run(
+            ["git", "commit", "-m",
+             "Record on-chip captures from recovered tunnel window\n\n"
+             "Automated by tools/on_recovery.py: bench series reps, the\n"
+             "kernel on-chip validation record, and the probe log."],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        print(f"[recovery] artifact commit: rc={r.returncode} "
+              f"{(r.stdout or r.stderr)[-200:]}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — capture must not die on git
+        print(f"[recovery] artifact commit failed: {e}", file=sys.stderr)
     return 0
 
 
