@@ -1,0 +1,71 @@
+"""Read BENCH_SERIES_r05.jsonl and diagnose each rep: where did the
+sharded leg's time go, and was the run channel-bound or code-bound?
+
+Per rep with a parsed result, prints one line:
+
+  ts  value  (whole-file / sharded MB/s)  fetch/place/block split
+  link_sustained  → verdict
+
+Verdicts:
+- ``channel-bound``: the sharded rate is within 30% of the sustained
+  link rate — the tunnel, not the delivery pipeline, set the ceiling;
+- ``place-bound``: device placement wall dominates the split but sits
+  well under the link rate — the pipeline's host→device path is the
+  suspect (transfer granularity, sync points);
+- ``fetch-bound``: network fetch wall dominates — peer/DCN side;
+- ``inconclusive``: missing fields (pre-instrumentation reps).
+
+Usage: python tools/diagnose_series.py [series.jsonl]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def diagnose(parsed: dict) -> str:
+    phases = parsed.get("sharded_phase_secs") or {}
+    link = parsed.get("link_sustained_mbps")
+    sharded = parsed.get("sharded_mbps")
+    fetch = phases.get("fetch_secs", phases.get("fetch_stall_secs"))
+    place = phases.get("place_secs")
+    if sharded is None or place is None:
+        return "inconclusive (pre-instrumentation rep)"
+    if link and sharded >= 0.7 * link:
+        return f"channel-bound (sharded {sharded} vs link {link} MB/s)"
+    if fetch is not None and place > 2 * max(fetch, 1e-9):
+        return (f"place-bound (place {place:.2f}s vs fetch {fetch:.2f}s"
+                + (f"; link {link} MB/s" if link else "") + ")")
+    if fetch is not None and fetch > 2 * place:
+        return f"fetch-bound (fetch {fetch:.2f}s vs place {place:.2f}s)"
+    return "mixed (no phase dominates 2:1)"
+
+
+def main() -> int:
+    path = Path(sys.argv[1]) if len(sys.argv) > 1 else \
+        REPO / "BENCH_SERIES_r05.jsonl"
+    for line in path.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        parsed = rec.get("parsed")
+        if not isinstance(parsed, dict) or "value" not in parsed:
+            continue
+        phases = parsed.get("sharded_phase_secs") or {}
+        print(f"{rec.get('ts', '?'):25s} {parsed['value']:>8} "
+              f"{parsed.get('unit', '')}  "
+              f"(file {parsed.get('whole_file_mbps', '?')} / "
+              f"sharded {parsed.get('sharded_mbps', '?')})  "
+              f"phases={json.dumps(phases) if phases else 'n/a'} "
+              f"block={parsed.get('sharded_block_secs', 'n/a')} "
+              f"→ {diagnose(parsed)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
