@@ -56,7 +56,7 @@ class ProxyConfig:
     #: extra CA bundle for verifying UPSTREAM servers (tests, corp proxies)
     upstream_ca: str | None = None
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         self.data_dir = Path(self.data_dir)
         self.cache_dir = Path(self.cache_dir)
 

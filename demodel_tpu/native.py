@@ -107,6 +107,9 @@ def lib() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         if _needs_build():
+            # demodel: allow(no-blocking-io-under-lock) — exactly-once
+            # module init: every caller NEEDS the build done before the
+            # dlopen below; the lock exists to serialize precisely this
             build()
         L = ctypes.CDLL(str(_SO))
         _configure(L)

@@ -21,11 +21,6 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-
 NEG_INF = -1e30  # large-but-finite: -inf rows would NaN through exp/where
 
 
@@ -179,11 +174,12 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "sp",
         v = jnp.pad(v, zq)
 
     spec = P(None, axis, None, None)
-    fn = _shard_map(
+    from demodel_tpu.parallel.collectives import shard_map_nocheck
+
+    fn = shard_map_nocheck(
         functools.partial(ring_attention, axis_name=axis, causal=causal,
                           kv_len=kv_len),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        mesh, (spec, spec, spec), spec,
     )
     out = fn(q, k, v)
     return out[:, :T] if pad else out

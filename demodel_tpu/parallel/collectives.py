@@ -16,8 +16,25 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map
+except ImportError:  # older jax: experimental home
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shard_map_nocheck(f, mesh: Mesh, in_specs, out_specs):
+    """``shard_map`` with the static replication checker disabled, across
+    the jax API rename (``check_rep`` until 0.5, ``check_vma`` from 0.6).
+    Collective outputs here ARE identical across the mapped axis, but the
+    checker can't statically infer that in either spelling."""
+    try:
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
 
 
 def redistribute(arr: jax.Array, sharding: NamedSharding) -> jax.Array:
@@ -47,10 +64,7 @@ def allgather_axis(arr: jax.Array, mesh: Mesh, axis: str = "tp") -> jax.Array:
 
     in_spec = P(axis, *([None] * (ndim - 1)))
     out_spec = P(*([None] * ndim))
-    # check_vma=False: all_gather output IS identical across `axis`, but the
-    # varying-axes checker can't statically infer that
-    return shard_map(gather, mesh=mesh, in_specs=(in_spec,),
-                     out_specs=out_spec, check_vma=False)(arr)
+    return shard_map_nocheck(gather, mesh, (in_spec,), out_spec)(arr)
 
 
 def psum_across(arr: jax.Array, mesh: Mesh, axis: str = "dp") -> jax.Array:
@@ -73,8 +87,7 @@ def psum_across(arr: jax.Array, mesh: Mesh, axis: str = "dp") -> jax.Array:
 
     in_spec = P(axis, *([None] * (arr.ndim - 1)))
     out_spec = P(*([None] * arr.ndim))
-    return shard_map(s, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
-                     check_vma=False)(arr)
+    return shard_map_nocheck(s, mesh, (in_spec,), out_spec)(arr)
 
 
 @functools.partial(jax.jit, static_argnames=("chunk_elems",))

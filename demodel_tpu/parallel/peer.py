@@ -98,11 +98,21 @@ class PeerSet:
             if fresh_enough(cached):
                 return cached[0]
             try:
+                # demodel: allow(no-blocking-io-under-lock) — per-peer
+                # single-flight lock guarding exactly this download (a
+                # cold-cache fetch fan-out must not stampede /peer/index);
+                # the instance-wide self._lock is never held across it
                 r = self.session.get(f"{peer}/peer/index", timeout=self.timeout)
                 r.raise_for_status()
-                keys = {e["key"]: e.get("sha256", "")
-                        for e in r.json().get("keys", [])}
-            except requests.RequestException as e:
+                body = r.json()
+                # shape-validate: a peer answering 200 with junk (captive
+                # portal, wrong service on the port) must degrade to an
+                # empty index, not crash the pull (peer-json-shape)
+                entries = body.get("keys", ()) if isinstance(body, dict) else ()
+                keys = {str(e["key"]): str(e.get("sha256") or "")
+                        for e in entries
+                        if isinstance(e, dict) and "key" in e}
+            except (requests.RequestException, ValueError, TypeError) as e:
                 log.warning("peer %s index failed: %s", peer, e)
                 keys = {}
             with self._lock:
@@ -156,6 +166,8 @@ class PeerSet:
                                     timeout=self.timeout)
             meta.raise_for_status()
             peer_meta = meta.json()
+            if not isinstance(peer_meta, dict):
+                raise IOError(f"peer meta for {remote_key} is not an object")
             want = expected_digest or peer_meta.get("sha256")
 
             if self._native_fetch(store, peer, key, want, peer_meta,
@@ -186,7 +198,12 @@ class PeerSet:
                     w.abort(keep_partial=True)
                 raise
             return True
-        except (requests.RequestException, OSError) as e:
+        except (requests.RequestException, OSError,
+                ValueError, TypeError) as e:
+            # ValueError/TypeError: malformed peer meta JSON (old requests
+            # raises json.JSONDecodeError=ValueError; a non-dict body makes
+            # .get raise TypeError) must fail over to upstream, not crash
+            # the whole pull (peer-json-shape)
             log.warning("peer fetch of %s from %s failed: %s", key, peer, e)
             return False
 
@@ -226,10 +243,13 @@ class PeerSet:
                                  timeout=self.timeout)
             r.raise_for_status()
             peer_meta = r.json()
-        except requests.RequestException as e:
+            # same shape-validation contract as fetch_into: junk meta from
+            # a peer degrades to "no peer copy", never a crashed delivery
+            size = int(peer_meta.get("size") or 0) \
+                if isinstance(peer_meta, dict) else 0
+        except (requests.RequestException, ValueError, TypeError) as e:
             log.warning("peer %s meta for %s failed: %s", peer, remote_key, e)
             return None
-        size = int(peer_meta.get("size") or 0)
         if size <= 0:
             return None
         want = expected_digest or peer_meta.get("sha256") or ""

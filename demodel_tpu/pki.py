@@ -185,20 +185,40 @@ class LeafMinter:
         self.use_ecdsa = use_ecdsa
         self._lock = threading.Lock()
         self._cache: dict[str, tuple[str, str]] = {}
+        #: per-host single-flight: concurrent fetches of ONE host mint once,
+        #: while distinct hosts mint in parallel
+        self._mint_locks: dict[str, threading.Lock] = {}
 
     def fetch(self, hostname: str) -> tuple[str, str]:
         """Return ``(cert_path, key_path)`` for ``hostname``, minting once.
 
-        Unlike the ref (``start.go:118-120``) the mint happens under the
-        lock, so two threads cannot mint the same host concurrently.
+        Unlike the ref (``start.go:118-120``) two threads cannot mint the
+        same host concurrently — a per-host mint lock single-flights the
+        mint. The mint itself (an RSA keygen taking whole seconds at the
+        reference's 4095-bit default, plus PEM file writes) runs OUTSIDE
+        the cache lock: holding the global lock across it serialized the
+        first CONNECT of every distinct host behind one keygen
+        (no-blocking-io-under-lock finding, PR 1).
         """
         with self._lock:
             hit = self._cache.get(hostname)
             if hit is not None:
                 return hit
+            mint_lock = self._mint_locks.setdefault(hostname,
+                                                    threading.Lock())
+        with mint_lock:
+            # double-check: another thread may have minted while we waited
+            with self._lock:
+                hit = self._cache.get(hostname)
+                if hit is not None:
+                    return hit
+            # demodel: allow(no-blocking-io-under-lock) — per-host
+            # single-flight lock guarding exactly this mint; the global
+            # cache lock is never held here
             paths = self._mint(hostname)
-            self._cache[hostname] = paths
-            return paths
+            with self._lock:
+                self._cache[hostname] = paths
+        return paths
 
     def _mint(self, hostname: str) -> tuple[str, str]:
         key = _new_key(self.use_ecdsa)

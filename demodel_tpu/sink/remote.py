@@ -530,6 +530,9 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
             merge_placement(placement, placed)
             report["weight_bytes"] += size
     t_block = time.perf_counter()
+    # demodel: allow(no-host-sync-in-hot-path) — the pod pull's single
+    # end-of-delivery sync: block_secs is reported, and every device
+    # transfer has already been dispatched when we get here
     jax.block_until_ready(list(placement.arrays.values()))
     report["block_secs"] = round(time.perf_counter() - t_block, 3)
     report["network_bytes"] = sum(r.bytes_fetched for r in readers)

@@ -236,6 +236,10 @@ class StreamingSink:
         if block and self.placement.arrays:
             import jax
 
+            # demodel: allow(no-host-sync-in-hot-path) — finish(block=True)
+            # IS the delivery's documented sync point: the caller asked for
+            # resident arrays, so the one sync happens here, after all
+            # transfers were dispatched
             jax.block_until_ready(list(self.placement.arrays.values()))
         log.info("streamed %d tensors (%.1f MB) onto mesh %s",
                  len(self.placement.arrays),

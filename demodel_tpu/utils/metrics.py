@@ -9,13 +9,15 @@ the content-addressed index.
 
 from __future__ import annotations
 
+import logging
 import threading
+from typing import Any
 
 
 class Hub:
     """Thread-safe named counters (monotonic)."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
 
@@ -43,7 +45,7 @@ def _fmt(value: float) -> str:
     return str(int(value)) if float(value).is_integer() else repr(value)
 
 
-def render(proxy=None, store=None) -> str:
+def render(proxy: Any = None, store: Any = None) -> str:
     """Prometheus text exposition (0.0.4): HUB counters as
     ``demodel_<name>``, native proxy counters as ``demodel_proxy_<name>``,
     store gauges as ``demodel_store_{objects,bytes}``."""
@@ -72,6 +74,16 @@ def render(proxy=None, store=None) -> str:
             lines.append("# TYPE demodel_store_evictions_total counter")
             lines.append(
                 f"demodel_store_evictions_total {store.evictions_total()}")
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — metrics must never take a
+            # node down, but a scrape silently missing its store gauges was
+            # undiagnosable (no-bare-except finding, PR 1)
+            _log().debug("store gauges unavailable: %s", e)
     return "\n".join(lines) + "\n"
+
+
+def _log() -> logging.Logger:
+    """Logger, resolved lazily: utils.metrics must stay import-light (it
+    is imported by the native store wrapper during early bring-up)."""
+    from demodel_tpu.utils.logging import get_logger
+
+    return get_logger("metrics")

@@ -1,0 +1,129 @@
+// Compile-time-optional runtime lock-order checking for the data plane.
+//
+// The Python-side analyzer (tools/analyze, lock-order pass) proves the
+// PYTHON lock graph acyclic statically; this shim is the C++ half: with
+// -DDM_LOCK_ORDER_CHECK every member mutex of Store/Proxy becomes a
+// ranked mutex, and acquiring a lock while holding one of equal or
+// higher rank aborts with a diagnostic. The TSan selftest builds with
+// the check on (native/Makefile selftest-tsan), so every selftest
+// operation doubles as a lock-order assertion run — cycles are caught
+// deterministically instead of only when the deadlock interleaving
+// happens to fire.
+//
+// Rank order (low = outermost, must be acquired first):
+//   Proxy:  sessions < fill < leaf < upstream < hint < restore
+//   Store:  gc < writers < index < pin < fd
+// Proxy locks rank below Store locks because proxy paths call into the
+// store while holding their own locks (register_tensor holds restore_mu_
+// across Store::pin/unpin), never the reverse.
+//
+// Deliberately out of scheme (plain std::mutex): FillState::mu (paired
+// with a condition_variable — std::condition_variable requires
+// std::unique_lock<std::mutex>) and RangeWriter::mu_ (per-writer leaf,
+// never held across another acquisition).
+#pragma once
+
+#include <mutex>
+
+#ifdef DM_LOCK_ORDER_CHECK
+#include <cstdio>
+#include <cstdlib>
+#endif
+
+namespace dm {
+
+// lock ranks (see ordering rationale above)
+constexpr int kRankProxySessions = 10;
+constexpr int kRankProxyFill = 12;
+constexpr int kRankProxyLeaf = 14;
+constexpr int kRankProxyUpstream = 16;
+constexpr int kRankProxyHint = 18;
+constexpr int kRankProxyRestore = 20;
+constexpr int kRankStoreGc = 30;
+constexpr int kRankStoreWriters = 32;
+constexpr int kRankStoreIndex = 34;
+constexpr int kRankStorePin = 36;
+constexpr int kRankStoreFd = 38;
+
+#ifdef DM_LOCK_ORDER_CHECK
+
+// Ranked mutex: lock() asserts the calling thread holds no dm::Mutex of
+// equal or higher rank. BasicLockable, so std::lock_guard works.
+class OrderedMutex {
+ public:
+  explicit OrderedMutex(int rank) : rank_(rank) {}
+  OrderedMutex(const OrderedMutex &) = delete;
+  OrderedMutex &operator=(const OrderedMutex &) = delete;
+
+  void lock() {
+    check_order();
+    mu_.lock();
+    push();
+  }
+
+  bool try_lock() {
+    // try_lock cannot deadlock, so no order assertion — but the held
+    // stack stays honest for later lock() calls
+    if (!mu_.try_lock()) return false;
+    push();
+    return true;
+  }
+
+  void unlock() {
+    pop();
+    mu_.unlock();
+  }
+
+ private:
+  static constexpr int kMaxHeld = 16;
+  static inline thread_local int t_held_[kMaxHeld] = {};
+  static inline thread_local int t_depth_ = 0;
+
+  void check_order() const {
+    for (int i = 0; i < t_depth_; ++i) {
+      if (t_held_[i] >= rank_) {
+        ::fprintf(stderr,
+                  "[demodel-tpu] lock-order violation: acquiring rank %d "
+                  "while holding rank %d (see native/lock_order.h)\n",
+                  rank_, t_held_[i]);
+        ::abort();
+      }
+    }
+  }
+
+  void push() const {
+    if (t_depth_ < kMaxHeld) t_held_[t_depth_] = rank_;
+    ++t_depth_;
+  }
+
+  void pop() const {
+    // unlock order is LIFO under lock_guard scoping, but tolerate
+    // out-of-order release: drop the topmost entry matching our rank
+    for (int i = (t_depth_ < kMaxHeld ? t_depth_ : kMaxHeld) - 1; i >= 0;
+         --i) {
+      if (t_held_[i] == rank_) {
+        for (int j = i; j + 1 < t_depth_ && j + 1 < kMaxHeld; ++j)
+          t_held_[j] = t_held_[j + 1];
+        break;
+      }
+    }
+    if (t_depth_ > 0) --t_depth_;
+  }
+
+  const int rank_;
+  std::mutex mu_;
+};
+
+using Mutex = OrderedMutex;
+
+#else  // !DM_LOCK_ORDER_CHECK
+
+// Zero-cost default: a std::mutex that swallows the rank argument.
+struct Mutex : std::mutex {
+  Mutex() = default;
+  explicit Mutex(int /*rank*/) {}
+};
+
+#endif  // DM_LOCK_ORDER_CHECK
+
+}  // namespace dm

@@ -63,10 +63,17 @@ struct Api {
 inline Api &api() {
   static Api a = [] {
     Api x = {};
-    void *ssl = ::dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
-    if (!ssl) ssl = ::dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
-    void *crypto = ::dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
-    if (!crypto) crypto = ::dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+    // same candidate order as sha256.h: OpenSSL 3 sonames, dev symlinks,
+    // then the 1.1 soname (the whole surface below exists since 1.1.0)
+    void *ssl = nullptr;
+    for (const char *name : {"libssl.so.3", "libssl.so", "libssl.so.1.1"}) {
+      if ((ssl = ::dlopen(name, RTLD_NOW | RTLD_GLOBAL)) != nullptr) break;
+    }
+    void *crypto = nullptr;
+    for (const char *name : {"libcrypto.so.3", "libcrypto.so",
+                             "libcrypto.so.1.1"}) {
+      if ((crypto = ::dlopen(name, RTLD_NOW | RTLD_GLOBAL)) != nullptr) break;
+    }
     if (!ssl || !crypto) {
       ::fprintf(stderr, "[demodel-tpu] fatal: cannot dlopen OpenSSL: %s\n",
                 ::dlerror());

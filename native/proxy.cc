@@ -347,14 +347,14 @@ class Session {
  public:
   Session(Proxy *proxy, int client_fd) : p_(proxy) {
     client_.fd = client_fd;
-    std::lock_guard<std::mutex> g(p_->sessions_mu_);
+    std::lock_guard<Mutex> g(p_->sessions_mu_);
     p_->sessions_.insert(this);
   }
   ~Session() {
     {
       // deregister BEFORE closing fds: stop() only touches fds of sessions
       // it can still see in the registry
-      std::lock_guard<std::mutex> g(p_->sessions_mu_);
+      std::lock_guard<Mutex> g(p_->sessions_mu_);
       p_->sessions_.erase(this);
     }
     client_.shutdown_close();
@@ -757,7 +757,7 @@ class Session {
       // out of its growing partial instead of re-pulling from upstream
       std::shared_ptr<FillState> fill;
       {
-        std::lock_guard<std::mutex> g(p_->fill_mu_);
+        std::lock_guard<Mutex> g(p_->fill_mu_);
         auto it = p_->fills_.find(key);
         if (it != p_->fills_.end()) fill = it->second;
       }
@@ -916,7 +916,7 @@ class Session {
     // -1 until the response head arrives (serve_from_fill waits on it)
     auto fill = std::make_shared<FillState>();
     {
-      std::lock_guard<std::mutex> g(p_->fill_mu_);
+      std::lock_guard<Mutex> g(p_->fill_mu_);
       p_->fills_[key] = fill;
     }
     auto finish_fill = [&](bool ok) {
@@ -926,7 +926,7 @@ class Session {
         fill->ok = ok;
       }
       fill->cv.notify_all();
-      std::lock_guard<std::mutex> g(p_->fill_mu_);
+      std::lock_guard<Mutex> g(p_->fill_mu_);
       auto it = p_->fills_.find(key);
       if (it != p_->fills_.end() && it->second == fill) p_->fills_.erase(it);
     };
@@ -1810,14 +1810,14 @@ void Proxy::record_hint(const std::string &authority, const std::string &locatio
   } else if (location.empty() || location[0] != '/') {
     return;
   }
-  std::lock_guard<std::mutex> g(hint_mu_);
+  std::lock_guard<Mutex> g(hint_mu_);
   if (digest_hints_.size() > 65536) digest_hints_.clear();  // bound memory
   digest_hints_[hint_key(auth, path)] = digest;
 }
 
 std::string Proxy::hint_digest(const std::string &authority,
                                const std::string &target) {
-  std::lock_guard<std::mutex> g(hint_mu_);
+  std::lock_guard<Mutex> g(hint_mu_);
   auto it = digest_hints_.find(hint_key(authority, target));
   return it == digest_hints_.end() ? "" : it->second;
 }
@@ -1833,7 +1833,7 @@ bool Proxy::should_mitm(const std::string &authority) const {
 
 SSL_CTX *Proxy::leaf_ctx(const std::string &host, std::string *err) {
   {
-    std::lock_guard<std::mutex> g(leaf_mu_);
+    std::lock_guard<Mutex> g(leaf_mu_);
     auto it = leaf_ctxs_.find(host);
     if (it != leaf_ctxs_.end()) return it->second;
   }
@@ -1854,7 +1854,7 @@ SSL_CTX *Proxy::leaf_ctx(const std::string &host, std::string *err) {
     if (ctx) SSL_CTX_free(ctx);
     return nullptr;
   }
-  std::lock_guard<std::mutex> g(leaf_mu_);
+  std::lock_guard<Mutex> g(leaf_mu_);
   auto it = leaf_ctxs_.find(host);
   if (it != leaf_ctxs_.end()) {  // lost a mint race; keep the first
     SSL_CTX_free(ctx);
@@ -1869,7 +1869,7 @@ void Proxy::register_tensor(const std::string &model_tensor, TensorLoc loc) {
   // an object the restore data plane is advertising (ADVICE r3 medium —
   // eviction would 404 or drop connections mid-restore).
   if (store_) store_->pin(loc.key);
-  std::lock_guard<std::mutex> g(restore_mu_);
+  std::lock_guard<Mutex> g(restore_mu_);
   auto it = restore_map_.find(model_tensor);
   if (it != restore_map_.end() && store_)
     store_->unpin(it->second.key);  // replaced registration frees its pin
@@ -1878,7 +1878,7 @@ void Proxy::register_tensor(const std::string &model_tensor, TensorLoc loc) {
 
 void Proxy::unregister_model(const std::string &model) {
   std::string prefix = model + "/";
-  std::lock_guard<std::mutex> g(restore_mu_);
+  std::lock_guard<Mutex> g(restore_mu_);
   for (auto it = restore_map_.begin(); it != restore_map_.end();) {
     if (it->first.size() > prefix.size() &&
         it->first.compare(0, prefix.size(), prefix) == 0) {
@@ -1891,7 +1891,7 @@ void Proxy::unregister_model(const std::string &model) {
 }
 
 void Proxy::unregister_tensor(const std::string &model_tensor) {
-  std::lock_guard<std::mutex> g(restore_mu_);
+  std::lock_guard<Mutex> g(restore_mu_);
   auto it = restore_map_.find(model_tensor);
   if (it != restore_map_.end()) {
     if (store_) store_->unpin(it->second.key);
@@ -1900,7 +1900,7 @@ void Proxy::unregister_tensor(const std::string &model_tensor) {
 }
 
 bool Proxy::lookup_tensor(const std::string &model_tensor, TensorLoc *out) {
-  std::lock_guard<std::mutex> g(restore_mu_);
+  std::lock_guard<Mutex> g(restore_mu_);
   auto it = restore_map_.find(model_tensor);
   if (it == restore_map_.end()) return false;
   if (out) *out = it->second;
@@ -1922,7 +1922,7 @@ void Proxy::maybe_gc() {
 }
 
 SSL_CTX *Proxy::upstream_ctx() {
-  std::lock_guard<std::mutex> g(upstream_mu_);
+  std::lock_guard<Mutex> g(upstream_mu_);
   if (upstream_ctx_) return upstream_ctx_;
   SSL_CTX *ctx = SSL_CTX_new(TLS_client_method());
   if (!ctx) return nullptr;
@@ -1995,12 +1995,12 @@ void Proxy::stop() {
   // the destructor frees state (store_, cfg_) that session threads use, so
   // returning early here would be a use-after-free
   {
-    std::lock_guard<std::mutex> g(sessions_mu_);
+    std::lock_guard<Mutex> g(sessions_mu_);
     for (Session *s : sessions_) s->force_close();
   }
   while (live_sessions_ > 0) {
     ::usleep(5 * 1000);
-    std::lock_guard<std::mutex> g(sessions_mu_);
+    std::lock_guard<Mutex> g(sessions_mu_);
     for (Session *s : sessions_) s->force_close();  // catch late registrants
   }
 }

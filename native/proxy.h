@@ -142,21 +142,24 @@ class Proxy {
   Store *store_ = nullptr;
   Metrics metrics_;
 
-  std::mutex leaf_mu_;
+  // member mutexes are rank-checked under -DDM_LOCK_ORDER_CHECK
+  // (lock_order.h; proxy ranks sit below store ranks because e.g.
+  // register_tensor holds restore_mu_ across Store::pin)
+  Mutex leaf_mu_{kRankProxyLeaf};
   std::unordered_map<std::string, SSL_CTX *> leaf_ctxs_;
-  std::mutex upstream_mu_;
+  Mutex upstream_mu_{kRankProxyUpstream};
   SSL_CTX *upstream_ctx_ = nullptr;
 
-  std::mutex hint_mu_;
+  Mutex hint_mu_{kRankProxyHint};
   std::unordered_map<std::string, std::string> digest_hints_;
 
-  std::mutex restore_mu_;
+  Mutex restore_mu_{kRankProxyRestore};
   std::unordered_map<std::string, TensorLoc> restore_map_;
 
-  std::mutex fill_mu_;
+  Mutex fill_mu_{kRankProxyFill};
   std::unordered_map<std::string, std::shared_ptr<FillState>> fills_;
 
-  std::mutex sessions_mu_;
+  Mutex sessions_mu_{kRankProxySessions};
   std::set<Session *> sessions_;
   std::atomic<bool> running_{false};
   std::atomic<int> live_sessions_{0};

@@ -32,8 +32,14 @@ struct Api {
 inline Api &api() {
   static Api a = [] {
     Api x = {};
-    void *h = ::dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
-    if (!h) h = ::dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+    // candidate list covers OpenSSL 3, dev-symlink installs, and 1.1-era
+    // images (every EVP symbol below is present since 1.1.0) — a host
+    // without the exact .3 soname must not abort the embedding process
+    void *h = nullptr;
+    for (const char *name : {"libcrypto.so.3", "libcrypto.so",
+                             "libcrypto.so.1.1"}) {
+      if ((h = ::dlopen(name, RTLD_NOW | RTLD_GLOBAL)) != nullptr) break;
+    }
     if (!h) {
       ::fprintf(stderr, "[demodel-tpu] fatal: cannot dlopen libcrypto: %s\n",
                 ::dlerror());

@@ -304,11 +304,11 @@ Store::~Store() {
     // a closing handle takes its pins with it: a daemon that restarts
     // its ProxyServer (new handle, new hid) must not leave the old
     // handle's markers pinning keys for the rest of the process's life
-    std::lock_guard<std::mutex> g(pin_mu_);
+    std::lock_guard<Mutex> g(pin_mu_);
     for (auto &p : pinned_) ::unlink(pin_path(p.first).c_str());
     pinned_.clear();
   }
-  std::lock_guard<std::mutex> g(fd_mu_);
+  std::lock_guard<Mutex> g(fd_mu_);
   for (auto &p : fd_cache_) ::close(p.second);
   fd_cache_.clear();
 }
@@ -390,7 +390,7 @@ int64_t Store::pread(const std::string &key, void *buf, int64_t len, int64_t off
 
 int Store::open_read_fd(const std::string &key) {
   if (!is_safe_key(key)) return -1;
-  std::lock_guard<std::mutex> g(fd_mu_);
+  std::lock_guard<Mutex> g(fd_mu_);
   auto it = fd_cache_.find(key);
   if (it != fd_cache_.end()) {
     // validate: a recommit replaces the inode; a stale fd would serve old bytes
@@ -431,12 +431,12 @@ int Store::open_read_fd(const std::string &key) {
 }
 
 bool Store::claim_writer(const std::string &key) {
-  std::lock_guard<std::mutex> g(writers_mu_);
+  std::lock_guard<Mutex> g(writers_mu_);
   return active_writers_.insert(key).second;
 }
 
 void Store::finish_writer(const std::string &key) {
-  std::lock_guard<std::mutex> g(writers_mu_);
+  std::lock_guard<Mutex> g(writers_mu_);
   active_writers_.erase(key);
 }
 
@@ -546,7 +546,7 @@ int Store::publish(const std::string &key, const std::string &meta_json,
   if (::rename(part_path(key).c_str(), obj_path(key).c_str()) != 0) return -errno;
   {
     // recommit under the same key: retire any stale cached fd
-    std::lock_guard<std::mutex> g(fd_mu_);
+    std::lock_guard<Mutex> g(fd_mu_);
     auto it = fd_cache_.find(key);
     if (it != fd_cache_.end()) {
       ::close(it->second);
@@ -589,7 +589,7 @@ int Store::remove(const std::string &key) {
   ::unlink(meta_path(key).c_str());
   ::unlink(part_path(key).c_str());
   {
-    std::lock_guard<std::mutex> g(fd_mu_);
+    std::lock_guard<Mutex> g(fd_mu_);
     auto it = fd_cache_.find(key);
     if (it != fd_cache_.end()) {
       ::close(it->second);
@@ -629,7 +629,7 @@ int Store::materialize(const std::string &key, const std::string &digest,
 }
 
 void Store::invalidate_index() {
-  std::lock_guard<std::mutex> g(index_mu_);
+  std::lock_guard<Mutex> g(index_mu_);
   index_mtime_ns_ = -1;
 }
 
@@ -644,7 +644,7 @@ std::string Store::index_json() {
   std::string dir = root_ + "/objects";
   int64_t now_mtime = dir_mtime_ns(dir);
   {
-    std::lock_guard<std::mutex> g(index_mu_);
+    std::lock_guard<Mutex> g(index_mu_);
     // revalidate by directory mtime so foreign-process writes show up
     if (index_mtime_ns_ >= 0 && index_mtime_ns_ == now_mtime)
       return index_cache_;
@@ -676,7 +676,7 @@ std::string Store::index_json() {
     ::closedir(d);
   }
   out += "]}";
-  std::lock_guard<std::mutex> g(index_mu_);
+  std::lock_guard<Mutex> g(index_mu_);
   index_cache_ = out;
   index_mtime_ns_ = now_mtime;
   return out;
@@ -686,7 +686,7 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
                   int *evicted_count) {
   if (freed_bytes) *freed_bytes = 0;
   if (evicted_count) *evicted_count = 0;
-  std::lock_guard<std::mutex> gcg(gc_mu_);
+  std::lock_guard<Mutex> gcg(gc_mu_);
 
   struct Entry {
     std::string key;
@@ -741,11 +741,11 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
   for (const Entry &en : entries) {
     if (total <= target) break;
     {
-      std::lock_guard<std::mutex> g(writers_mu_);
+      std::lock_guard<Mutex> g(writers_mu_);
       if (active_writers_.count(en.key)) continue;  // never an active key
     }
     {
-      std::lock_guard<std::mutex> g(pin_mu_);
+      std::lock_guard<Mutex> g(pin_mu_);
       if (pinned_.count(en.key)) continue;  // restore-registered: serving
     }
     int64_t cur = pins_mtime();
@@ -766,7 +766,7 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
     ::unlink(meta_path(en.key).c_str());
     // partials are NOT touched: a resumable download survives eviction
     {
-      std::lock_guard<std::mutex> g(fd_mu_);
+      std::lock_guard<Mutex> g(fd_mu_);
       auto it = fd_cache_.find(en.key);
       if (it != fd_cache_.end()) {
         ::close(it->second);
@@ -844,7 +844,7 @@ std::set<std::string> Store::foreign_pins() {
 }
 
 void Store::pin(const std::string &key) {
-  std::lock_guard<std::mutex> g(pin_mu_);
+  std::lock_guard<Mutex> g(pin_mu_);
   if (++pinned_[key] == 1) {
     // first pin by this handle: drop a marker other handles' GC sees.
     // The body records our starttime so a recycled pid (or a post-
@@ -876,7 +876,7 @@ void Store::pin(const std::string &key) {
 }
 
 void Store::unpin(const std::string &key) {
-  std::lock_guard<std::mutex> g(pin_mu_);
+  std::lock_guard<Mutex> g(pin_mu_);
   auto it = pinned_.find(key);
   if (it != pinned_.end() && --it->second <= 0) {
     pinned_.erase(it);

@@ -22,6 +22,8 @@
 #include <string>
 #include <unordered_map>
 
+#include "lock_order.h"
+
 namespace dm {
 
 class Store;
@@ -177,20 +179,22 @@ class Store {
 
   std::string root_;
 
-  std::mutex writers_mu_;
+  // member mutexes are rank-checked under -DDM_LOCK_ORDER_CHECK
+  // (lock_order.h documents the order; the TSan selftest enforces it)
+  Mutex writers_mu_{kRankStoreWriters};
   std::set<std::string> active_writers_;
 
-  std::mutex fd_mu_;
+  Mutex fd_mu_{kRankStoreFd};
   std::unordered_map<std::string, int> fd_cache_;  // key → open O_RDONLY fd
-  std::mutex pin_mu_;
+  Mutex pin_mu_{kRankStorePin};
   std::map<std::string, int> pinned_;  // key → pin refcount (GC skips >0)
   int64_t hid_ = 0;  // per-process handle id disambiguating pin markers
 
-  std::mutex index_mu_;
+  Mutex index_mu_{kRankStoreIndex};
   std::string index_cache_;
   int64_t index_mtime_ns_ = -1;  // objects/ dir mtime when cache was built
 
-  std::mutex gc_mu_;  // one GC pass at a time
+  Mutex gc_mu_{kRankStoreGc};  // one GC pass at a time
   std::atomic<int64_t> evictions_total_{0};
 };
 

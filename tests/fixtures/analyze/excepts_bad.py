@@ -1,0 +1,26 @@
+"""Golden fixture: no-bare-except."""
+
+
+def retry_fetch(fetch, attempts=3):
+    for _ in range(attempts):
+        try:
+            return fetch()
+        except:                     # line 8: bare except
+            continue
+    return None
+
+
+def swallow(fetch):
+    try:
+        return fetch()
+    except Exception:               # line 16: broad + silent
+        pass
+    return None
+
+
+def fine(fetch, log):
+    try:
+        return fetch()
+    except OSError as e:            # narrow + handled: no finding
+        log.warning("fetch failed: %s", e)
+        return None

@@ -1,0 +1,26 @@
+# demodel: hot-path
+"""Golden fixture: no-host-sync-in-hot-path must fire on every marked line.
+
+Never imported — parsed only by tools.analyze in tests.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def deliver(shards):
+    acc = jnp.zeros((8,))
+    for s in shards:
+        acc = jnp.add(acc, s)
+    jax.block_until_ready(acc)          # line 15: hard sync
+    host = np.asarray(acc)              # line 16: converter on device value
+    total = float(acc)                  # line 17: float() on device value
+    first = acc.item()                  # line 18: .item() sync
+    direct = np.array(jnp.ones((2,)))   # line 19: converter on jnp call
+    return host, total, first, direct
+
+
+def fine(shards):
+    # host-side numpy math on host values must NOT fire
+    buf = np.zeros((8,))
+    return float(buf.sum())

@@ -1,0 +1,43 @@
+"""Golden fixture: jit-hygiene."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def traced_branch(x, threshold):
+    if threshold > 0:               # line 10: Python `if` on traced arg
+        return x * threshold
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def static_ok(x, n):
+    if n > 2:                       # static arg: no finding
+        return x[:n]
+    return x
+
+
+@jax.jit
+def traced_while(x, steps):
+    while steps > 0:                # line 24: Python `while` on traced arg
+        x = x + 1
+        steps = steps - 1
+    return x
+
+
+@jax.jit
+def structural_ok(x, cache):
+    if cache is None:               # `is None` is structural: no finding
+        return x
+    return x + cache
+
+
+unhashable = jax.jit(lambda x, n: x, static_argnums=[1])   # line 37: list
+
+
+def helper(x):
+    if x > 0:                       # not jitted: no finding
+        return -x
+    return x

@@ -1,0 +1,32 @@
+"""Golden fixture: peer-json-shape."""
+import requests
+
+
+def peer_index(session, peer, log):
+    try:
+        r = session.get(f"{peer}/peer/index", timeout=5)
+        r.raise_for_status()
+        body = r.json()
+        keys = body.get("keys", [])         # line 10: .get() on JSON body
+        return {e["key"] for e in keys}     # line 11: iteration + subscript
+    except requests.RequestException as e:  # network-only handler
+        log.warning("peer %s index failed: %s", peer, e)
+        return set()
+
+
+def peer_meta_ok(session, peer, key, log):
+    try:
+        r = session.get(f"{peer}/peer/meta/{key}", timeout=5)
+        r.raise_for_status()
+        meta = r.json()
+        return meta.get("sha256", "")       # guarded below: no finding
+    except (requests.RequestException, ValueError, TypeError) as e:
+        log.warning("peer %s meta failed: %s", peer, e)
+        return ""
+
+
+def no_access_ok(session, url):
+    try:
+        return session.get(url, timeout=5).json()   # no shape access here
+    except requests.RequestException:
+        return None

@@ -1,0 +1,33 @@
+"""Golden fixture: no-blocking-io-under-lock."""
+import threading
+import time
+
+import requests
+
+_lock = threading.Lock()
+
+
+def _refresh_index(session):
+    return session.get("http://peer/peer/index", timeout=5)
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}
+
+    def warm(self, session, url):
+        with self._lock:
+            r = session.get(url, timeout=30)      # line 21: HTTP under lock
+            time.sleep(0.1)                       # line 22: sleep under lock
+            self.entries[url] = r
+        return self.entries[url]
+
+    def warm_indirect(self, session):
+        with self._lock:
+            idx = _refresh_index(session)         # line 28: blocking callee
+        return idx
+
+    def ok(self, key, value):
+        with self._lock:                          # pure dict work: no finding
+            self.entries[key] = value

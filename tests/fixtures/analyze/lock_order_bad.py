@@ -1,0 +1,28 @@
+"""Golden fixture: lock-order (A→B in one path, B→A in another)."""
+import threading
+
+
+class Node:
+    def __init__(self):
+        self._store_lock = threading.Lock()
+        self._peer_lock = threading.Lock()
+
+    def publish(self):
+        with self._store_lock:
+            with self._peer_lock:       # line 12: edge store → peer
+                return True
+
+    def fetch(self):
+        with self._peer_lock:
+            with self._store_lock:      # line 17: edge peer → store (cycle)
+                return True
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def oops(self):
+        with self._lock:
+            with self._lock:            # line 27: self-deadlock
+                return True

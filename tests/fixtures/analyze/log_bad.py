@@ -1,0 +1,13 @@
+"""Golden fixture: log-hygiene."""
+import logging
+
+log = logging.getLogger("fixture")
+
+
+def report(key, nbytes, secs):
+    log.info(f"fetched {key}: {nbytes} bytes")        # line 8: f-string
+    log.debug("fetched %s in %.2fs" % (key, secs))    # line 9: eager %
+    log.warning("slow fetch of {}".format(key))       # line 10: .format
+    log.error("failed " + key)                        # line 11: concat
+    log.info("fetched %s: %d bytes in %.2fs",         # lazy form: no finding
+             key, nbytes, secs)

@@ -9,6 +9,10 @@ import hashlib
 import pytest
 import requests
 
+# MITM PKI needs `cryptography` (pulled by `pip install -e .`); a
+# dep-light checkout must skip-collect, not error (ISSUE 1 satellite)
+pytest.importorskip("cryptography")
+
 from demodel_tpu import pki
 from demodel_tpu.config import ProxyConfig
 from demodel_tpu.delivery import materialize

@@ -17,6 +17,10 @@ import jax.numpy as jnp
 transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
 
+# MITM PKI needs `cryptography` (pulled by `pip install -e .`); a
+# dep-light checkout must skip-collect, not error (ISSUE 1 satellite)
+pytest.importorskip("cryptography")
+
 from demodel_tpu import delivery  # noqa: E402
 from demodel_tpu.config import ProxyConfig  # noqa: E402
 from demodel_tpu.formats import safetensors as st  # noqa: E402

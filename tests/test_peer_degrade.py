@@ -1,0 +1,113 @@
+"""Peer-plane degradation contract: junk from a peer must DEGRADE the
+pull (skip the peer, fall to upstream), never crash it.
+
+Regression tests for the `peer-json-shape` findings fixed in PR 1
+(tools/analyze): a peer answering 200 with a captive portal's HTML, a
+JSON string, or a wrong-shape document used to raise
+AttributeError/TypeError out of `PeerSet.index`/`fetch_into` and kill
+the whole delivery. Deliberately dependency-light (no cryptography/MITM
+machinery) so the suite runs in dep-light environments too.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from demodel_tpu.parallel.peer import PeerSet
+from demodel_tpu.store import Store
+
+
+class _ConfigurableHandler(BaseHTTPRequestHandler):
+    #: path prefix → (status, content_type, body bytes); set per test
+    routes: dict[str, tuple[int, str, bytes]] = {}
+
+    def log_message(self, *a):  # noqa: ARG002 — silence test server
+        pass
+
+    def do_GET(self):
+        for prefix, (status, ctype, body) in self.routes.items():
+            if self.path.startswith(prefix):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+        self.send_response(404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+@pytest.fixture
+def peer_server():
+    handler = type("Handler", (_ConfigurableHandler,), {"routes": {}})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{httpd.server_address[1]}", handler
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+@pytest.mark.parametrize("body, ctype", [
+    (b"<html>hotel wifi login</html>", "text/html"),
+    (b'"just a string"', "application/json"),
+    (b"[1, 2, 3]", "application/json"),
+    (b'{"keys": "not-a-list"}', "application/json"),
+])
+def test_junk_index_degrades_to_empty(peer_server, body, ctype):
+    peer, handler = peer_server
+    handler.routes["/peer/index"] = (200, ctype, body)
+    ps = PeerSet([peer], timeout=5)
+    assert ps.index(peer) == {}
+    assert ps.locate("deadbeefdeadbeef") is None
+
+
+def test_malformed_index_entries_are_skipped(peer_server):
+    peer, handler = peer_server
+    handler.routes["/peer/index"] = (200, "application/json", (
+        b'{"keys": [17, {"nokey": true}, '
+        b'{"key": "aaaabbbbccccdddd", "sha256": "ff00"}, '
+        b'{"key": "eeeeffff00001111"}]}'
+    ))
+    ps = PeerSet([peer], timeout=5)
+    assert ps.index(peer) == {"aaaabbbbccccdddd": "ff00",
+                              "eeeeffff00001111": ""}
+
+
+def test_junk_meta_fails_over_not_crashes(peer_server, tmp_path):
+    """fetch_into: peer advertises the key but serves a non-object meta
+    document — the fetch must return False (upstream fallback), not raise."""
+    peer, handler = peer_server
+    key = "aaaabbbbccccdddd"
+    handler.routes["/peer/index"] = (
+        200, "application/json",
+        ('{"keys": [{"key": "%s"}]}' % key).encode())
+    handler.routes[f"/peer/meta/{key}"] = (
+        200, "application/json", b"[1, 2, 3]")
+    store = Store(tmp_path / "store")
+    try:
+        ps = PeerSet([peer], timeout=5)
+        assert ps.fetch_into(store, key) is False
+        assert not store.has(key)
+    finally:
+        store.close()
+
+
+def test_junk_meta_in_memory_path_returns_none(peer_server):
+    """fetch_to_memory: junk meta (or a junk size field) degrades to
+    'no peer copy' instead of raising out of the delivery path."""
+    peer, handler = peer_server
+    key = "aaaabbbbccccdddd"
+    handler.routes["/peer/index"] = (
+        200, "application/json",
+        ('{"keys": [{"key": "%s"}]}' % key).encode())
+    handler.routes[f"/peer/meta/{key}"] = (
+        200, "application/json", b'"surprise"')
+    ps = PeerSet([peer], timeout=5)
+    assert ps.fetch_to_memory(key) is None

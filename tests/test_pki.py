@@ -4,6 +4,12 @@ semantics without the bugs (pwd-relative trust path, mint race)."""
 import ssl
 import threading
 
+import pytest
+
+# MITM PKI needs `cryptography` (pulled by `pip install -e .`); a
+# dep-light checkout must skip-collect, not error (ISSUE 1 satellite)
+pytest.importorskip("cryptography")
+
 from cryptography import x509
 from cryptography.x509.oid import ExtensionOID
 

@@ -7,6 +7,10 @@ import numpy as np
 import pytest
 import requests
 
+# MITM PKI needs `cryptography` (pulled by `pip install -e .`); a
+# dep-light checkout must skip-collect, not error (ISSUE 1 satellite)
+pytest.importorskip("cryptography")
+
 from demodel_tpu import delivery
 from demodel_tpu.config import ProxyConfig
 from demodel_tpu.parallel.peer import PeerSet

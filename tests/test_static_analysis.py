@@ -1,0 +1,166 @@
+"""Tier-1 gate for the repo-native static analyzer (tools/analyze).
+
+Three contracts:
+
+1. the demodel_tpu tree is CLEAN — zero unsuppressed findings (the same
+   gate CI runs via ``python -m tools.analyze demodel_tpu``);
+2. every shipped rule FIRES — golden fixture files under
+   tests/fixtures/analyze each contain known violations, asserted by
+   exact (rule-id, line);
+3. the ``# demodel: allow(rule)`` suppression machinery works, scoped to
+   the named rule.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "analyze"
+
+sys.path.insert(0, str(REPO))  # tools/ is repo-rooted, not installed
+
+from tools.analyze import REGISTRY, analyze_paths  # noqa: E402
+
+
+ALL_RULES = {
+    "no-host-sync-in-hot-path",
+    "no-blocking-io-under-lock",
+    "no-bare-except",
+    "jit-hygiene",
+    "lock-order",
+    "log-hygiene",
+    "peer-json-shape",
+}
+
+#: fixture file → exact expected (rule, line) findings
+GOLDEN = {
+    "host_sync_bad.py": {
+        ("no-host-sync-in-hot-path", 15),
+        ("no-host-sync-in-hot-path", 16),
+        ("no-host-sync-in-hot-path", 17),
+        ("no-host-sync-in-hot-path", 18),
+        ("no-host-sync-in-hot-path", 19),
+    },
+    "lock_io_bad.py": {
+        ("no-blocking-io-under-lock", 21),
+        ("no-blocking-io-under-lock", 22),
+        ("no-blocking-io-under-lock", 28),
+    },
+    "excepts_bad.py": {
+        ("no-bare-except", 8),
+        ("no-bare-except", 16),
+    },
+    "jit_bad.py": {
+        ("jit-hygiene", 10),
+        ("jit-hygiene", 24),
+        ("jit-hygiene", 37),
+    },
+    "lock_order_bad.py": {
+        ("lock-order", 17),
+        ("lock-order", 27),
+    },
+    "log_bad.py": {
+        ("log-hygiene", 8),
+        ("log-hygiene", 9),
+        ("log-hygiene", 10),
+        ("log-hygiene", 11),
+    },
+    "json_shape_bad.py": {
+        ("peer-json-shape", 10),
+        ("peer-json-shape", 11),
+    },
+}
+
+
+def test_registry_complete():
+    import tools.analyze.passes  # noqa: F401 — populate
+
+    assert ALL_RULES <= set(REGISTRY), (
+        f"missing passes: {ALL_RULES - set(REGISTRY)}")
+
+
+def test_every_rule_has_a_golden_fixture():
+    covered = {rule for findings in GOLDEN.values() for rule, _ in findings}
+    assert covered == ALL_RULES
+
+
+@pytest.mark.parametrize("fixture", sorted(GOLDEN))
+def test_golden_fixture_fires(fixture):
+    path = FIXTURES / fixture
+    active, suppressed = analyze_paths([path], root=REPO)
+    got = {(f.rule, f.line) for f in active}
+    assert got == GOLDEN[fixture], (
+        f"{fixture}: got {sorted(got)}, want {sorted(GOLDEN[fixture])}")
+    assert not suppressed
+
+
+def test_tree_is_clean():
+    """The product tree must carry zero unsuppressed findings — real
+    defects get FIXED, intentional patterns get a justified allow()."""
+    active, _ = analyze_paths([REPO / "demodel_tpu"], root=REPO)
+    assert active == [], "unsuppressed findings:\n" + "\n".join(
+        f.render() for f in active)
+
+
+def test_tree_suppressions_are_rule_scoped():
+    """Every in-tree suppression names a registered rule (no allow(*) —
+    blanket waivers hide new findings on the same line)."""
+    import re
+
+    import tools.analyze.passes  # noqa: F401
+
+    pat = re.compile(r"#\s*demodel:\s*allow\(([^)]*)\)")
+    for path in sorted((REPO / "demodel_tpu").rglob("*.py")):
+        for m in pat.finditer(path.read_text()):
+            ids = {tok.strip() for tok in m.group(1).split(",")}
+            assert "*" not in ids, f"blanket allow(*) in {path}"
+            unknown = ids - set(REGISTRY)
+            assert not unknown, f"unknown rule(s) {unknown} in {path}"
+
+
+def test_suppression_is_scoped_to_named_rule(tmp_path):
+    src = (
+        "def f(fetch):\n"
+        "    try:\n"
+        "        return fetch()\n"
+        "    except:  # demodel: allow(no-bare-except)\n"
+        "        return None\n"
+    )
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    active, suppressed = analyze_paths([p], root=tmp_path)
+    assert active == []
+    assert [(f.rule, f.line) for f in suppressed] == [("no-bare-except", 4)]
+
+    # a different rule id does NOT suppress it
+    p.write_text(src.replace("no-bare-except", "log-hygiene"))
+    active, suppressed = analyze_paths([p], root=tmp_path)
+    assert [(f.rule, f.line) for f in active] == [("no-bare-except", 4)]
+    assert suppressed == []
+
+
+def test_cli_exit_codes():
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "demodel_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    dirty = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "tests/fixtures/analyze"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert dirty.returncode == 1
+    # findings print as file:line rule-id message
+    assert "tests/fixtures/analyze/log_bad.py:8 log-hygiene" in dirty.stdout
+
+
+def test_cli_list_rules():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    for rule in ALL_RULES:
+        assert rule in out.stdout

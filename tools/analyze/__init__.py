@@ -1,0 +1,23 @@
+"""Repo-native static analysis for the demodel-tpu tree.
+
+A small pass framework (``python -m tools.analyze``) that walks Python
+sources with :mod:`ast` and runs pluggable rule passes tuned to this
+stack's failure modes: host↔device syncs on delivery hot paths, blocking
+I/O under locks, swallowed exceptions in failover paths, jit tracing
+hazards, module-level lock-order cycles, eager log formatting, and
+unguarded JSON shape access on peer responses.
+
+Findings print as ``file:line rule-id message`` and are suppressible
+inline with ``# demodel: allow(<rule-id>)`` on the offending line or the
+line above. See ``tools/analyze/README.md`` for the rule catalogue and
+how to add a pass.
+"""
+
+from tools.analyze.core import (  # noqa: F401 — public surface
+    Finding,
+    ModuleContext,
+    Pass,
+    REGISTRY,
+    analyze_paths,
+    register,
+)

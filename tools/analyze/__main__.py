@@ -1,0 +1,65 @@
+"""CLI driver: ``python -m tools.analyze [paths...]``.
+
+Exit code 0 when the tree has no unsuppressed findings, 1 otherwise —
+what tier-1 (tests/test_static_analysis.py) and CI gate on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.analyze.core import REGISTRY, analyze_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="demodel-tpu static analysis passes",
+    )
+    ap.add_argument("paths", nargs="*", default=["demodel_tpu"],
+                    help="files/directories to analyze (default: demodel_tpu)")
+    ap.add_argument("--rule", action="append", default=[],
+                    help="run only this rule id (repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings (marked)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        import tools.analyze.passes  # noqa: F401 — populate REGISTRY
+
+        for rule_id in sorted(REGISTRY):
+            print(f"{rule_id}: {REGISTRY[rule_id].description}")
+        return 0
+
+    paths = [Path(p) for p in (args.paths or ["demodel_tpu"])]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(f"no such path: {', '.join(map(str, missing))}", file=sys.stderr)
+        return 2
+    active, suppressed = analyze_paths(paths, rule_ids=args.rule or None)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in active],
+            "suppressed": [vars(f) for f in suppressed],
+        }, indent=2))
+    else:
+        for f in active:
+            print(f.render())
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f"{f.render()}  [suppressed]")
+        tail = f"{len(active)} finding(s), {len(suppressed)} suppressed"
+        print(tail, file=sys.stderr)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Pass framework: module contexts, the rule registry, suppression, driver.
+
+Design notes
+------------
+- One :class:`ModuleContext` per file: parsed tree with parent links, raw
+  source lines, and a ``hot`` bit (delivery hot-path modules, where the
+  host-sync rule applies).
+- A :class:`Pass` sees every module via :meth:`Pass.visit` and may emit
+  more findings from :meth:`Pass.finalize` after the whole walk (the
+  lock-order pass builds its graph that way).
+- Suppression is inline and rule-scoped: ``# demodel: allow(rule-id)``
+  (comma-separated ids, or ``*``) on the finding's line or the line
+  directly above. Suppressed findings are still collected so tests can
+  assert the suppression machinery works.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Iterator
+
+SUPPRESS_RE = re.compile(r"#\s*demodel:\s*allow\(([^)]*)\)")
+HOT_PRAGMA_RE = re.compile(r"#\s*demodel:\s*hot-path")
+
+#: delivery hot-path packages — the host-sync rule applies only here (plus
+#: any file carrying an explicit ``# demodel: hot-path`` pragma, which is
+#: how the golden fixtures opt in)
+HOT_DIRS = ("demodel_tpu/ops", "demodel_tpu/sink", "demodel_tpu/parallel")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative posix path
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+class ModuleContext:
+    """One parsed source file plus the per-file facts passes need."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._dm_parent = node  # type: ignore[attr-defined]
+        self.module = rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+        self.hot = (
+            any(rel.startswith(d + "/") or rel == d for d in HOT_DIRS)
+            or HOT_PRAGMA_RE.search(source) is not None
+        )
+
+    def src(self, node: ast.AST) -> str:
+        """Best-effort source text of ``node`` (for messages/matching)."""
+        seg = ast.get_source_segment(self.source, node)
+        if seg is not None:
+            return seg
+        try:
+            return ast.unparse(node)
+        except Exception:  # pragma: no cover - unparse of odd nodes
+            return "<expr>"
+
+
+class Pass:
+    """Base class for rule passes. Subclass, set ``id``/``description``,
+    implement :meth:`visit` (and :meth:`finalize` for whole-project
+    rules), then :func:`register` it and import the module from
+    ``tools.analyze.passes``."""
+
+    id = ""
+    description = ""
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize(self) -> Iterator[Finding]:
+        return iter(())
+
+
+REGISTRY: dict[str, type[Pass]] = {}
+
+
+def register(cls: type[Pass]) -> type[Pass]:
+    if not cls.id:
+        raise ValueError(f"pass {cls.__name__} has no id")
+    if cls.id in REGISTRY:
+        raise ValueError(f"duplicate pass id {cls.id}")
+    REGISTRY[cls.id] = cls
+    return cls
+
+
+# --------------------------------------------------------------- helpers
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def walk_in_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``node``'s body without descending into nested function or
+    class definitions (their bodies run in a different dynamic context —
+    e.g. code inside a nested ``def`` does not execute under the
+    enclosing ``with``)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                          ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def enclosing_function(node: ast.AST) -> ast.AST | None:
+    cur = getattr(node, "_dm_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = getattr(cur, "_dm_parent", None)
+    return None
+
+
+def enclosing_class(node: ast.AST) -> ast.ClassDef | None:
+    cur = getattr(node, "_dm_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = getattr(cur, "_dm_parent", None)
+    return None
+
+
+# ----------------------------------------------------------- suppression
+
+
+def suppressions(source: str) -> dict[int, set[str]]:
+    """1-based line number → rule ids allowed on that line (``*`` = all).
+
+    An inline allow applies to its own line (and the next, so a trailing
+    comment can cover a continuation). An allow on a comment-only line
+    covers the whole comment block plus the first code line after it —
+    justification lines between the allow and the code are encouraged.
+    """
+    out: dict[int, set[str]] = {}
+    lines = source.splitlines()
+
+    def add(line_no: int, ids: set[str]) -> None:
+        out.setdefault(line_no, set()).update(ids)
+
+    for i, line in enumerate(lines, start=1):
+        m = SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        ids = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+        ids = ids or {"*"}
+        add(i, ids)
+        if line.strip().startswith("#"):
+            # comment-only allow: extend through the comment block to the
+            # first code line
+            j = i + 1
+            while j <= len(lines) and (
+                not lines[j - 1].strip()
+                or lines[j - 1].strip().startswith("#")
+            ):
+                add(j, ids)
+                j += 1
+            if j <= len(lines):
+                add(j, ids)
+    return out
+
+
+def is_suppressed(finding: Finding, sup: dict[int, set[str]]) -> bool:
+    for line in (finding.line, finding.line - 1):
+        ids = sup.get(line)
+        if ids and ("*" in ids or finding.rule in ids):
+            return True
+    return False
+
+
+# ----------------------------------------------------------------- driver
+
+
+def iter_py_files(paths: Iterable[Path]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    return files
+
+
+def analyze_paths(
+    paths: Iterable[Path],
+    rule_ids: Iterable[str] | None = None,
+    root: Path | None = None,
+) -> tuple[list[Finding], list[Finding]]:
+    """Run the (selected) passes over every ``.py`` under ``paths``.
+
+    Returns ``(active, suppressed)`` findings, both sorted. ``root``
+    anchors the repo-relative paths in findings (defaults to cwd).
+    """
+    # pass modules self-register on import
+    import tools.analyze.passes  # noqa: F401
+
+    root = Path(root) if root is not None else Path.cwd()
+    ids = list(rule_ids) if rule_ids else sorted(REGISTRY)
+    unknown = [i for i in ids if i not in REGISTRY]
+    if unknown:
+        raise ValueError(f"unknown rule ids: {', '.join(unknown)}")
+    passes = [REGISTRY[i]() for i in ids]
+
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+
+    def bucket(findings: Iterable[Finding], sup: dict[int, set[str]]) -> None:
+        for f in findings:
+            (suppressed if is_suppressed(f, sup) else active).append(f)
+
+    sups: dict[str, dict[int, set[str]]] = {}
+    for path in iter_py_files(paths):
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        source = path.read_text(encoding="utf-8", errors="replace")
+        try:
+            ctx = ModuleContext(path, rel, source)
+        except SyntaxError as e:
+            active.append(Finding(rel, e.lineno or 1, "parse-error", str(e)))
+            continue
+        sups[rel] = suppressions(source)
+        for p in passes:
+            bucket(p.visit(ctx), sups[rel])
+    for p in passes:
+        for f in p.finalize():
+            bucket([f], sups.get(f.path, {}))
+    key = lambda f: (f.path, f.line, f.rule)  # noqa: E731
+    return sorted(active, key=key), sorted(suppressed, key=key)

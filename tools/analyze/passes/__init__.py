@@ -1,0 +1,12 @@
+"""Rule passes. Importing this package registers every rule; add a new
+pass by dropping a module here and importing it below."""
+
+from tools.analyze.passes import (  # noqa: F401 — registration imports
+    excepts,
+    host_sync,
+    jit_hygiene,
+    json_shape,
+    lock_io,
+    lock_order,
+    log_hygiene,
+)

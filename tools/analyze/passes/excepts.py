@@ -1,0 +1,67 @@
+"""no-bare-except: bare ``except:`` clauses, and broad handlers that
+swallow silently.
+
+A bare except catches ``KeyboardInterrupt``/``SystemExit`` and hides the
+cancellation paths the delivery pipeline relies on. A broad
+``except Exception:``/``except BaseException:`` whose body is only
+``pass``/``continue`` erases the failure entirely — in a retry or
+failover path that converts real corruption into silent degradation.
+Handlers that log, re-raise, or record the error are fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analyze.core import Finding, ModuleContext, Pass, dotted, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _caught_names(handler: ast.ExceptHandler) -> set[str]:
+    t = handler.type
+    nodes = t.elts if isinstance(t, ast.Tuple) else ([t] if t else [])
+    out = set()
+    for n in nodes:
+        name = dotted(n)
+        if name:
+            out.add(name.split(".")[-1])
+    return out
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+@register
+class BareExceptPass(Pass):
+    id = "no-bare-except"
+    description = (
+        "bare `except:` and broad `except Exception: pass` handlers that "
+        "silently swallow failures in retry/failover paths"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    "bare except catches KeyboardInterrupt/SystemExit and "
+                    "hides cancellation — name the exception classes",
+                )
+                continue
+            if _caught_names(node) & _BROAD and _swallows(node):
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    "broad handler swallows the failure with no log, "
+                    "re-raise, or record — at minimum log it",
+                )

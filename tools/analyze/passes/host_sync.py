@@ -1,0 +1,127 @@
+"""no-host-sync-in-hot-path: flag device→host synchronization on the
+delivery hot path (demodel_tpu/{ops,sink,parallel}).
+
+``.block_until_ready()``, plus ``np.asarray``/``np.array``/``float``/
+``int``/``bool``/``.item()``/``.tolist()`` applied to values produced by
+``jnp.*``/``jax.*`` calls in the same function. Each of these forces the
+host to wait on the device stream — inside the streamed-delivery window
+that serializes fetch, dispatch, and transfer and silently caps
+throughput.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analyze.core import (
+    Finding,
+    ModuleContext,
+    Pass,
+    dotted,
+    register,
+    walk_in_scope,
+)
+
+#: jax.* calls that return HOST values (device handles, counts, pytree
+#: plumbing) — their results are not device arrays, so consuming them on
+#: the host is not a sync
+_HOST_RESULT = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.process_count", "jax.process_index",
+    "jax.default_backend", "jax.make_mesh", "jax.random.split",
+}
+_HOST_RESULT_PREFIXES = ("jax.tree", "jax.sharding", "jax.dtypes")
+
+_CONVERTERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "float", "int", "bool"}
+_SYNC_METHODS = {"item", "tolist"}
+
+
+def _device_producer(call: ast.Call) -> bool:
+    name = dotted(call.func)
+    if not name:
+        return False
+    if name in _HOST_RESULT or name.startswith(_HOST_RESULT_PREFIXES):
+        return False
+    return name.startswith(("jnp.", "jax."))
+
+
+def _tainted_names(fn: ast.AST) -> set[str]:
+    """Names assigned from a jnp./jax. call in ``fn``'s own scope (nested
+    defs are separate scopes analyzed on their own — a closure's device
+    locals must not taint same-named host values outside it)."""
+    out: set[str] = set()
+    for node in walk_in_scope(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and _device_producer(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+@register
+class HostSyncPass(Pass):
+    id = "no-host-sync-in-hot-path"
+    description = (
+        "device→host sync (.block_until_ready / np.asarray / float / .item "
+        "on device values) inside demodel_tpu/{ops,sink,parallel}"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.hot:
+            return
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        seen: set[int] = set()
+        for scope in scopes:
+            tainted = _tainted_names(scope) if scope is not ctx.tree else set()
+            for node in walk_in_scope(scope):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                f = self._check_call(ctx, node, tainted)
+                if f is not None:
+                    seen.add(id(node))
+                    yield f
+
+    def _check_call(self, ctx: ModuleContext, node: ast.Call,
+                    tainted: set[str]) -> Finding | None:
+        name = dotted(node.func)
+        # hard sync, whatever the receiver
+        if name == "jax.block_until_ready" or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+        ):
+            return Finding(
+                ctx.rel, node.lineno, self.id,
+                "block_until_ready forces a full device sync on the hot "
+                "path — move it off the delivery critical path",
+            )
+        # .item()/.tolist() on a device-tainted name
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in tainted):
+            return Finding(
+                ctx.rel, node.lineno, self.id,
+                f".{node.func.attr}() on device value "
+                f"{node.func.value.id!r} copies to host and blocks on the "
+                "device stream",
+            )
+        # host converters applied to a device value
+        if name in _CONVERTERS and node.args:
+            arg = node.args[0]
+            arg_is_device = (
+                (isinstance(arg, ast.Name) and arg.id in tainted)
+                or (isinstance(arg, ast.Call) and _device_producer(arg))
+            )
+            if arg_is_device:
+                return Finding(
+                    ctx.rel, node.lineno, self.id,
+                    f"{name}(...) on a device value materializes it on host "
+                    "(hidden device sync + copy)",
+                )
+        return None

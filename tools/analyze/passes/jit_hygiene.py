@@ -1,0 +1,137 @@
+"""jit-hygiene: Python-level control flow on traced arguments inside
+``@jax.jit`` functions, and non-hashable static-arg declarations.
+
+``if``/``while`` on a traced value raises ``TracerBoolConversionError``
+at trace time at best; at worst (when the branch happens to be constant
+under the first trace) it silently bakes one branch into the compiled
+program. ``x is None`` / ``x is not None`` tests and ``isinstance``
+checks are structural (the argument is Python-level there) and are
+allowed. ``static_argnums``/``static_argnames`` passed as a list/set/
+dict display is unhashable-by-convention — jit accepts some of these at
+Python level but the cache key contract wants tuples.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analyze.core import Finding, ModuleContext, Pass, dotted, register
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _jit_call(node: ast.AST) -> ast.Call | None:
+    """The ``jax.jit(...)`` call inside a decorator/callsite expression,
+    unwrapping ``functools.partial(jax.jit, ...)``."""
+    if isinstance(node, ast.Call):
+        name = dotted(node.func)
+        if name in _JIT_NAMES:
+            return node
+        if name in ("functools.partial", "partial") and node.args:
+            inner = dotted(node.args[0])
+            if inner in _JIT_NAMES:
+                return node
+    elif dotted(node) in _JIT_NAMES:
+        # bare @jax.jit decorator — no kwargs
+        return None
+    return None
+
+
+def _is_jit_decorator(node: ast.AST) -> bool:
+    if dotted(node) in _JIT_NAMES:
+        return True
+    return _jit_call(node) is not None
+
+
+def _static_names(call: ast.Call | None, fn: ast.FunctionDef) -> set[str]:
+    """Param names declared static via static_argnums/static_argnames."""
+    if call is None:
+        return set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: set[str] = set()
+    for kw in call.keywords:
+        val = kw.value
+        items = val.elts if isinstance(val, (ast.Tuple, ast.List, ast.Set)) \
+            else [val]
+        if kw.arg == "static_argnames":
+            out |= {i.value for i in items
+                    if isinstance(i, ast.Constant) and isinstance(i.value, str)}
+        elif kw.arg == "static_argnums":
+            for i in items:
+                if isinstance(i, ast.Constant) and isinstance(i.value, int) \
+                        and i.value < len(params):
+                    out.add(params[i.value])
+    return out
+
+
+def _branch_hazards(fn: ast.FunctionDef, traced: set[str]):
+    """(node, name) for if/while tests referencing a traced param."""
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        test = node.test
+        # structural tests are fine: `x is (not) None`, isinstance(x, T)
+        if isinstance(test, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            continue
+        if isinstance(test, ast.Call) and dotted(test.func) == "isinstance":
+            continue
+        # names only referenced inside isinstance(...) are structural
+        structural: set[int] = set()
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call) and dotted(sub.func) == "isinstance":
+                structural.update(id(n) for n in ast.walk(sub))
+        for sub in ast.walk(test):
+            if (isinstance(sub, ast.Name) and sub.id in traced
+                    and id(sub) not in structural):
+                yield node, sub.id
+                break
+
+
+@register
+class JitHygienePass(Pass):
+    id = "jit-hygiene"
+    description = (
+        "Python if/while on traced args inside @jax.jit functions; "
+        "list/set/dict static_argnums declarations (unhashable cache keys)"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        # non-hashable static declarations at ANY jit call site
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and dotted(node.func) in _JIT_NAMES:
+                for kw in node.keywords:
+                    if kw.arg in ("static_argnums", "static_argnames") \
+                            and isinstance(kw.value,
+                                           (ast.List, ast.Set, ast.Dict)):
+                        yield Finding(
+                            ctx.rel, node.lineno, self.id,
+                            f"{kw.arg} given a "
+                            f"{type(kw.value).__name__.lower()} display — "
+                            "use a hashable tuple",
+                        )
+        # traced-arg branching in decorated functions
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            jit_deco = None
+            for deco in fn.decorator_list:
+                if _is_jit_decorator(deco):
+                    jit_deco = deco
+                    break
+            if jit_deco is None:
+                continue
+            static = _static_names(_jit_call(jit_deco), fn)
+            params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                      + fn.args.kwonlyargs}
+            traced = params - static - {"self", "cls"}
+            for node, name in _branch_hazards(fn, traced):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    f"Python `{kind}` on traced argument {name!r} inside a "
+                    "jitted function — use lax.cond/lax.while_loop or mark "
+                    "the argument static",
+                )

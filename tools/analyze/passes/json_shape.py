@@ -1,0 +1,150 @@
+"""peer-json-shape: unguarded shape access on HTTP-response JSON inside
+failover try blocks.
+
+The peer/registry failover contract is "a broken peer degrades, it never
+kills the pull". With modern ``requests`` a malformed *body* surfaces as
+``RequestException`` — but a peer answering 200 with the wrong *shape*
+(a captive portal's HTML-as-string, a list where a dict is expected, a
+missing key) raises ``ValueError``/``TypeError``/``KeyError``/
+``AttributeError`` from the access, escapes a handler that only catches
+network errors, and crashes the whole pull.
+
+This pass flags ``try`` blocks that (a) call ``<response>.json()``,
+(b) access the result's shape (subscript, method call, or iteration) in
+the same block, and (c) have no handler covering ``ValueError`` and
+``TypeError`` (or a broader class).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analyze.core import Finding, ModuleContext, Pass, dotted, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _caught(handlers: list[ast.ExceptHandler]) -> set[str]:
+    out: set[str] = set()
+    for h in handlers:
+        t = h.type
+        nodes = t.elts if isinstance(t, ast.Tuple) else ([t] if t else [])
+        for n in nodes:
+            name = dotted(n)
+            if name:
+                out.add(name.split(".")[-1])
+    return out
+
+
+def _shape_guarded(caught: set[str]) -> bool:
+    if caught & _BROAD:
+        return True
+    return {"ValueError", "TypeError"} <= caught
+
+
+@register
+class JsonShapePass(Pass):
+    id = "peer-json-shape"
+    description = (
+        "response.json() shape-accessed in a failover try whose handlers "
+        "catch neither ValueError nor TypeError — junk from a peer crashes "
+        "the pull instead of failing over"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if not node.handlers:
+                # try/finally catches nothing — guarding (or not) is the
+                # enclosing try's business, which gets its own visit
+                continue
+            if _shape_guarded(_caught(node.handlers)):
+                continue
+            yield from self._scan_body(ctx, node)
+
+    def _scan_body(self, ctx: ModuleContext,
+                   node: ast.Try) -> Iterator[Finding]:
+        # taint: names assigned from `<x>.json()` within this try body
+        tainted: set[str] = set()
+        body_nodes: list[ast.AST] = []
+        for stmt in node.body:
+            body_nodes.extend(ast.walk(stmt))
+        for sub in body_nodes:
+            if isinstance(sub, ast.Assign) and self._is_json_call(sub.value):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+            # one propagation step: x = <tainted>.get(...) etc.
+        if not tainted and not any(
+            self._is_json_call(s) for s in body_nodes
+        ):
+            return
+        # propagate through single method-call/subscript assignments
+        changed = True
+        while changed:
+            changed = False
+            for sub in body_nodes:
+                if isinstance(sub, ast.Assign) \
+                        and isinstance(sub.value, (ast.Call, ast.Subscript)) \
+                        and self._root_name(sub.value) in tainted:
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name) and tgt.id not in tainted:
+                            tainted.add(tgt.id)
+                            changed = True
+                if isinstance(sub, ast.For) and isinstance(sub.target,
+                                                           ast.Name) \
+                        and self._root_name(sub.iter) in tainted \
+                        and sub.target.id not in tainted:
+                    tainted.add(sub.target.id)
+                    changed = True
+        seen_lines: set[int] = set()
+        for sub in body_nodes:
+            access = self._shape_access(sub, tainted)
+            if not access:
+                continue
+            # ast.comprehension carries no lineno — use its iterable's
+            line = getattr(sub, "lineno", None) or sub.iter.lineno
+            if line not in seen_lines:
+                seen_lines.add(line)
+                yield Finding(
+                    ctx.rel, line, self.id,
+                    f"{access} on response JSON, but the handlers catch "
+                    "neither ValueError nor TypeError — malformed peer "
+                    "output escapes the failover",
+                )
+
+    @staticmethod
+    def _is_json_call(n: ast.AST) -> bool:
+        return (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "json" and not n.args and not n.keywords)
+
+    @classmethod
+    def _root_name(cls, n: ast.AST) -> str | None:
+        """Leftmost Name of a call/subscript/attribute chain (also sees
+        through ``x.json()`` receivers)."""
+        while True:
+            if isinstance(n, ast.Call):
+                n = n.func
+            elif isinstance(n, (ast.Attribute, ast.Subscript)):
+                n = n.value
+            elif isinstance(n, ast.Name):
+                return n.id
+            else:
+                return None
+
+    def _shape_access(self, n: ast.AST, tainted: set[str]) -> str | None:
+        def is_tainted(v: ast.AST) -> bool:
+            return (isinstance(v, ast.Name) and v.id in tainted) \
+                or self._is_json_call(v)
+
+        if isinstance(n, ast.Subscript) and is_tainted(n.value):
+            return "subscript access"
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and is_tainted(n.func.value) and n.func.attr != "json":
+            return f".{n.func.attr}() call"
+        if isinstance(n, (ast.For, ast.comprehension)) \
+                and is_tainted(n.iter):
+            return "iteration"
+        return None

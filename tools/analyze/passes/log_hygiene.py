@@ -1,0 +1,64 @@
+"""log-hygiene: eagerly-formatted log calls.
+
+``log.debug(f"...{x}...")`` (or ``%``-/``.format()``-/concatenation-
+formatted first arguments) pay the formatting cost even when the record
+is filtered out. On per-chunk/per-request paths that work shows up in
+profiles; the logging module's lazy form ``log.debug("...%s...", x)``
+formats only when the record is actually emitted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analyze.core import Finding, ModuleContext, Pass, register
+
+_LEVELS = {"debug", "info", "warning", "error", "exception", "critical"}
+
+
+def _is_logger(recv: ast.AST) -> bool:
+    if isinstance(recv, ast.Name):
+        return recv.id in ("log", "logger") or recv.id.endswith("log")
+    if isinstance(recv, ast.Attribute):
+        return recv.attr in ("log", "logger") or recv.attr.endswith("_log")
+    return False
+
+
+def _eager_kind(arg: ast.AST) -> str | None:
+    if isinstance(arg, ast.JoinedStr):
+        return "f-string"
+    if isinstance(arg, ast.BinOp):
+        if isinstance(arg.op, ast.Mod):
+            return "%-interpolation"
+        if isinstance(arg.op, ast.Add):
+            return "string concatenation"
+    if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Attribute) \
+            and arg.func.attr == "format":
+        return ".format() call"
+    return None
+
+
+@register
+class LogHygienePass(Pass):
+    id = "log-hygiene"
+    description = (
+        "eagerly-formatted log calls (f-string/%/.format/concat) — use the "
+        "lazy `log.level(\"..%s..\", x)` form"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _LEVELS
+                    and _is_logger(node.func.value)
+                    and node.args):
+                continue
+            kind = _eager_kind(node.args[0])
+            if kind is not None:
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    f"{kind} formats eagerly even when the record is "
+                    "filtered — pass args lazily",
+                )
