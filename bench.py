@@ -2,6 +2,11 @@
 
 Primary metric (BASELINE.md): cold-pull→HBM wall-clock / MB/s/chip sustained.
 
+This driver times the DELIVERY side of the system; its twin
+``tools/bench_serve.py`` (same one-JSON-line contract) times the SERVE
+side — hot-hit re-serving from a warm store through the bounded session
+pool. Run both to cover the two halves of the north star.
+
 This drives the REAL pipeline end-to-end, staging the north-star scenario
 ("cold-pull→HBM from a warm peer, ≥3× faster than hf-cli + restore"):
 
@@ -389,9 +394,11 @@ def _bench_e2e() -> dict:
            if control_real is not None else {}),
         # sharded-leg phase split (fetch vs device-place vs final block):
         # the network-bound / transfer-bound diagnosis for slow pulls —
-        # on a tunneled backend these differ by 10× and name the culprit
-        **({"sharded_phase_secs": report_sh["phase_secs"]}
-           if report_sh.get("phase_secs") else {}),
+        # on a tunneled backend these differ by 10× and name the culprit.
+        # Emitted UNCONDITIONALLY: PROFILE_r05's diagnosis flow keys on
+        # this field, and an absent split is indistinguishable from a
+        # driver that forgot to record it ({} = the leg reported no split)
+        "sharded_phase_secs": report_sh.get("phase_secs") or {},
         **({"sharded_block_secs": report_sh["block_secs"]}
            if report_sh.get("block_secs") is not None else {}),
         # north-star projection: BASELINE.md's Llama-2-7B is ~13 GB —

@@ -18,11 +18,15 @@ import subprocess
 import sys
 from pathlib import Path
 
-from demodel_tpu import pki
 from demodel_tpu.config import ProxyConfig
 
 
 def _cmd_init(cfg: ProxyConfig, args) -> int:
+    # PKI (and its `cryptography` dependency) loads only for the commands
+    # that mint/export certificates — `start --no-mitm`/`serve`/peer nodes
+    # stay dep-light
+    from demodel_tpu import pki
+
     ca = pki.read_or_new_ca(cfg.data_dir, use_ecdsa=cfg.use_ecdsa)
     cert_path, _ = pki.ca_paths(cfg.data_dir)
     print(f"CA ready at {cert_path}", file=sys.stderr)
@@ -71,6 +75,8 @@ def install_system_trust(pem: bytes) -> bool:
 
 
 def _cmd_export_ca(cfg: ProxyConfig, args) -> int:
+    from demodel_tpu import pki
+
     cert_path, _ = pki.ca_paths(cfg.data_dir)
     if not cert_path.exists():
         print("CA not initialized; run `demodel-tpu init` first", file=sys.stderr)
@@ -159,7 +165,11 @@ def _export_openssl(pem: bytes) -> None:
 def _cmd_start(cfg: ProxyConfig, args) -> int:
     from demodel_tpu.proxy import ProxyServer
 
-    server = ProxyServer(cfg)
+    # getattr: bare `demodel-tpu` (no subcommand) routes here with the
+    # root-parser namespace, which has no serve_* attributes
+    server = ProxyServer(cfg,
+                         session_threads=getattr(args, "serve_threads", None),
+                         session_queue=getattr(args, "serve_queue", None))
     server.start()
     print(
         f"demodel-tpu proxy listening on {cfg.host}:{cfg.port} "
@@ -233,7 +243,9 @@ def _cmd_serve(cfg: ProxyConfig, args) -> int:
     from demodel_tpu.proxy import ProxyServer
     from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
 
-    proxy = ProxyServer(cfg)
+    proxy = ProxyServer(cfg,
+                        session_threads=getattr(args, "serve_threads", None),
+                        session_queue=getattr(args, "serve_queue", None))
     proxy.start()
     store = restore = None
     try:
@@ -269,7 +281,7 @@ def build_parser() -> argparse.ArgumentParser:
         "datasets — TPU-native. Bare invocation starts the proxy.",
     )
     sub = p.add_subparsers(dest="cmd")
-    sub.add_parser("start", help="run the MITM caching proxy")
+    st = sub.add_parser("start", help="run the MITM caching proxy")
     sub.add_parser("init", help="create the root CA")
     e = sub.add_parser("export-ca", help="export/install the root CA")
     e.add_argument("--for", dest="for_", action="append", default=[],
@@ -288,6 +300,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "(implies --sink=tpu; requires --peer)")
     sv = sub.add_parser("serve", help="run proxy + peer + restore APIs")
     sv.add_argument("--restore-port", type=int, default=8081)
+    for serving in (st, sv):
+        # bounded session executor (see README "Serve-plane tuning"):
+        # explicit flag > DEMODEL_PROXY_THREADS/_QUEUE env > 2×CPUs auto
+        serving.add_argument("--serve-threads", type=int, default=None,
+                             help="session worker pool size "
+                                  "(default: DEMODEL_PROXY_THREADS or 2×CPUs)")
+        serving.add_argument("--serve-queue", type=int, default=None,
+                             help="accept-queue bound; overflow is answered "
+                                  "503 + Retry-After (default: "
+                                  "DEMODEL_PROXY_QUEUE or 4×pool)")
     g = sub.add_parser("gc", help="evict LRU cache entries to a size cap")
     g.add_argument("--max-gb", type=int, default=0)
     mf = sub.add_parser(

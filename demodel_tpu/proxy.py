@@ -13,7 +13,7 @@ import json
 import os
 import threading
 
-from demodel_tpu import native, pki
+from demodel_tpu import native
 from demodel_tpu.config import ProxyConfig
 from demodel_tpu.utils.env import env_int
 
@@ -36,31 +36,46 @@ class ProxyServer:
         verbose: bool = True,
         io_timeout_sec: int = 75,
         max_body_mb: int = 64,
+        session_threads: int | None = None,
+        session_queue: int | None = None,
     ):
         self.cfg = cfg
         if upstream_ca is None:
             upstream_ca = cfg.upstream_ca
         self._lib = native.lib()
         self._setup_sigs()
-        self.ca = pki.read_or_new_ca(cfg.data_dir, use_ecdsa=cfg.use_ecdsa)
-        self._minter = pki.LeafMinter(self.ca, cfg.data_dir, use_ecdsa=cfg.use_ecdsa)
         self._stop_evt = threading.Event()
 
-        def _mint(host: bytes, cert_out, key_out, cap: int) -> int:
-            try:
-                cert, key = self._minter.fetch(host.decode())
-                cb = cert.encode() + b"\0"
-                kb = key.encode() + b"\0"
-                if len(cb) > cap or len(kb) > cap:
-                    return -1
-                ctypes.memmove(cert_out, cb, len(cb))
-                ctypes.memmove(key_out, kb, len(kb))
-                return 0
-            except Exception:  # noqa: BLE001 — crossing the C boundary
-                return -1
+        if cfg.no_mitm:
+            # a pure tunnel/peer-serve node never mints leaves, so the PKI
+            # stack (and its `cryptography` dependency) is not required —
+            # peer/restore serving must work on dep-light hosts
+            self.ca = None
+            self._minter = None
+            self._mint_cb = None
+        else:
+            from demodel_tpu import pki
 
-        # keep a reference: the native side holds this pointer for its lifetime
-        self._mint_cb = _MINT_CB(_mint)
+            self.ca = pki.read_or_new_ca(cfg.data_dir, use_ecdsa=cfg.use_ecdsa)
+            self._minter = pki.LeafMinter(self.ca, cfg.data_dir,
+                                          use_ecdsa=cfg.use_ecdsa)
+
+            def _mint(host: bytes, cert_out, key_out, cap: int) -> int:
+                try:
+                    cert, key = self._minter.fetch(host.decode())
+                    cb = cert.encode() + b"\0"
+                    kb = key.encode() + b"\0"
+                    if len(cb) > cap or len(kb) > cap:
+                        return -1
+                    ctypes.memmove(cert_out, cb, len(cb))
+                    ctypes.memmove(key_out, kb, len(kb))
+                    return 0
+                except Exception:  # noqa: BLE001 — crossing the C boundary
+                    return -1
+
+            # keep a reference: the native side holds this pointer for its
+            # lifetime
+            self._mint_cb = _MINT_CB(_mint)
 
         store_root = str(cfg.cache_dir / "proxy") if cfg.cache_enabled else ""
         self._h = self._lib.dm_proxy_new(
@@ -72,7 +87,8 @@ class ProxyServer:
             store_root.encode(),
             (upstream_ca or "").encode(),
             1 if cfg.cache_enabled else 0,
-            ctypes.cast(self._mint_cb, ctypes.c_void_p),
+            ctypes.cast(self._mint_cb, ctypes.c_void_p)
+            if self._mint_cb is not None else None,
             1 if verbose else 0,
             io_timeout_sec,
             env_int("DEMODEL_MAX_BODY_MB", max_body_mb),
@@ -82,6 +98,11 @@ class ProxyServer:
             env_int("DEMODEL_FILL_MAX_MB", 512),
             env_int("DEMODEL_FILL_MIN_PCT", 5),
             env_int("DEMODEL_CHALLENGE_TTL_S", 86400),
+            # bounded session executor: explicit value wins, 0 lets the
+            # native side resolve DEMODEL_PROXY_THREADS / DEMODEL_PROXY_QUEUE
+            # then fall back to the affinity-aware default (2×CPUs)
+            session_threads if session_threads is not None else 0,
+            session_queue if session_queue is not None else 0,
         )
         if not self._h:
             raise OSError("proxy allocation failed")
@@ -94,7 +115,8 @@ class ProxyServer:
         L.dm_proxy_new.argtypes = [
             c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_char_p,
             c.c_char_p, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_int64,
-            c.c_int64, c.c_int, c.c_int64, c.c_int, c.c_int,
+            c.c_int64, c.c_int, c.c_int64, c.c_int, c.c_int, c.c_int,
+            c.c_int,
         ]
         L.dm_proxy_new.restype = c.c_void_p
         L.dm_proxy_start.argtypes = [c.c_void_p]

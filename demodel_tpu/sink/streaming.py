@@ -70,7 +70,10 @@ class ByteBudget:
         with self._cv:
             while (self._in_use > 0 and self._in_use + nbytes > self.max_bytes
                    and not self._aborted):
-                self._cv.wait(0.2)
+                # pure wait: every state change that can unblock this
+                # predicate (release, abort) notify_all()s, so no timeout
+                # poll is needed — waiters wake on the event, not 0.2s late
+                self._cv.wait()
             self._in_use += nbytes
 
     def release(self, nbytes: int) -> None:

@@ -40,6 +40,10 @@ class Hub:
 
 HUB = Hub()
 
+#: native proxy metrics that are point-in-time pool state, not monotonic
+#: counters — the session executor's live occupancy and queue depth
+PROXY_GAUGES = frozenset({"sessions_active", "sessions_queue_depth"})
+
 
 def _fmt(value: float) -> str:
     return str(int(value)) if float(value).is_integer() else repr(value)
@@ -61,7 +65,8 @@ def render(proxy: Any = None, store: Any = None) -> str:
             native = {}
         for name, value in sorted(native.items()):
             metric = f"demodel_proxy_{name}"
-            lines.append(f"# TYPE {metric} counter")
+            mtype = "gauge" if name in PROXY_GAUGES else "counter"
+            lines.append(f"# TYPE {metric} {mtype}")
             lines.append(f"{metric} {_fmt(value)}")
     if store is not None:
         try:

@@ -11,7 +11,7 @@
 // happens to fire.
 //
 // Rank order (low = outermost, must be acquired first):
-//   Proxy:  sessions < fill < leaf < upstream < hint < restore
+//   Proxy:  queue < sessions < fill < leaf < upstream < hint < restore
 //   Store:  gc < writers < index < pin < fd
 // Proxy locks rank below Store locks because proxy paths call into the
 // store while holding their own locks (register_tensor holds restore_mu_
@@ -33,6 +33,7 @@
 namespace dm {
 
 // lock ranks (see ordering rationale above)
+constexpr int kRankProxyQueue = 8;
 constexpr int kRankProxySessions = 10;
 constexpr int kRankProxyFill = 12;
 constexpr int kRankProxyLeaf = 14;

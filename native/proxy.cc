@@ -6,6 +6,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <sched.h>
 #include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -16,6 +17,7 @@
 #include <cerrno>
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <sstream>
 
@@ -306,16 +308,22 @@ static int tcp_connect(const std::string &host, int port, int timeout_sec,
 }
 
 std::string Metrics::json() const {
-  char buf[512];
+  char buf[768];
   ::snprintf(buf, sizeof buf,
              "{\"connects\":%llu,\"mitm\":%llu,\"tunnel\":%llu,\"requests\":%llu,"
              "\"cache_hits\":%llu,\"cache_misses\":%llu,\"bytes_up\":%llu,"
-             "\"bytes_down\":%llu,\"bytes_cache\":%llu,\"errors\":%llu}",
+             "\"bytes_down\":%llu,\"bytes_cache\":%llu,\"errors\":%llu,"
+             "\"sessions_active\":%llu,\"sessions_queue_depth\":%llu,"
+             "\"sessions_rejected_total\":%llu,\"serve_bytes_total\":%llu}",
              (unsigned long long)connects.load(), (unsigned long long)mitm.load(),
              (unsigned long long)tunnel.load(), (unsigned long long)requests.load(),
              (unsigned long long)cache_hits.load(), (unsigned long long)cache_misses.load(),
              (unsigned long long)bytes_up.load(), (unsigned long long)bytes_down.load(),
-             (unsigned long long)bytes_cache.load(), (unsigned long long)errors.load());
+             (unsigned long long)bytes_cache.load(), (unsigned long long)errors.load(),
+             (unsigned long long)sessions_active.load(),
+             (unsigned long long)sessions_queue_depth.load(),
+             (unsigned long long)sessions_rejected.load(),
+             (unsigned long long)serve_bytes.load());
   return buf;
 }
 
@@ -525,7 +533,7 @@ class Session {
         // (peer shard exchange over DCN rides this data plane —
         // SURVEY.md §2.3 "Cross-host / cross-pod peer cache")
         if (req.target == "/healthz" || req.target == "/metrics") {
-          std::string body = p_->metrics_.json();
+          std::string body = p_->metrics_json();
           char head[256];
           ::snprintf(head, sizeof head,
                      "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
@@ -548,6 +556,11 @@ class Session {
           if (!client_.write_all(head, ::strlen(head)) ||
               !client_.write_all(body.data(), body.size()))
             return;
+          // store-served bytes only: /peer/index is generated from the
+          // store, so it counts toward serve_bytes (the /healthz|/metrics
+          // handler above deliberately does NOT — a scraper polling an
+          // idle node must not fabricate serve traffic)
+          p_->metrics_.serve_bytes += body.size();
           RequestHead next;
           if (!parse_request_head(&client_, &next)) return;
           req = next;
@@ -570,6 +583,7 @@ class Session {
           if (!client_.write_all(head, ::strlen(head)) ||
               !client_.write_all(meta.data(), meta.size()))
             return;
+          p_->metrics_.serve_bytes += meta.size();
           RequestHead next;
           if (!parse_request_head(&client_, &next)) return;
           req = next;
@@ -1179,6 +1193,7 @@ class Session {
       }
       sent += n;
       p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
+      p_->metrics_.serve_bytes += static_cast<uint64_t>(n);
     }
     ::close(fd);
     return ok ? 1 : 0;
@@ -1609,6 +1624,7 @@ class Session {
           }
           sent += n;
           p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
+          p_->metrics_.serve_bytes += static_cast<uint64_t>(n);
         }
         ::close(fd);
         return ok;
@@ -1623,6 +1639,7 @@ class Session {
       if (!client_.write_all(buf.data(), static_cast<size_t>(n))) return false;
       sent += n;
       p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
+      p_->metrics_.serve_bytes += static_cast<uint64_t>(n);
     }
     return true;
   }
@@ -1682,8 +1699,10 @@ class Session {
               "\r\nX-Demodel-Cache: HIT\r\nConnection: keep-alive\r\n\r\n";
       log_response(req, uri, 401, ct, size, true);
       if (!client_.write_all(head.data(), head.size())) return false;
-      return req.method == "HEAD" || body.empty() ||
-             client_.write_all(body.data(), body.size());
+      if (req.method == "HEAD" || body.empty()) return true;
+      if (!client_.write_all(body.data(), body.size())) return false;
+      p_->metrics_.serve_bytes += body.size();
+      return true;
     }
 
     int64_t off = 0, len = size;
@@ -1741,6 +1760,7 @@ class Session {
           }
           sent += n;
           p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
+          p_->metrics_.serve_bytes += static_cast<uint64_t>(n);
         }
         ::close(fd);
         return ok;
@@ -1755,6 +1775,7 @@ class Session {
       if (!client_.write_all(buf.data(), static_cast<size_t>(n))) return false;
       sent += n;
       p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
+      p_->metrics_.serve_bytes += static_cast<uint64_t>(n);
     }
     return true;
   }
@@ -1934,6 +1955,124 @@ SSL_CTX *Proxy::upstream_ctx() {
   return ctx;
 }
 
+// CPUs this process may actually run on — the C++ twin of the Python
+// side's utils.env.available_cpus(): sched_getaffinity sees cgroup and
+// affinity limits, nprocs is the fallback.
+static int available_cpus() {
+  cpu_set_t set;
+  CPU_ZERO(&set);
+  if (::sched_getaffinity(0, sizeof set, &set) == 0) {
+    int n = CPU_COUNT(&set);
+    if (n > 0) return n;
+  }
+  long n = ::sysconf(_SC_NPROCESSORS_ONLN);
+  return n > 0 ? static_cast<int>(n) : 1;
+}
+
+// Positive integer env value, or 0 when unset/malformed (degrade-not-crash:
+// a fat-fingered value falls back to the computed default, same policy as
+// the Python side's env_int).
+static int env_pos_int(const char *name) {
+  const char *v = ::getenv(name);
+  if (!v || !*v) return 0;
+  char *end = nullptr;
+  long n = ::strtol(v, &end, 10);
+  if (end == v || *end != '\0' || n <= 0) {
+    ::fprintf(stderr, "[demodel-tpu] %s=%s is not a positive integer; "
+              "using default\n", name, v);
+    return 0;
+  }
+  return n > 4096 ? 4096 : static_cast<int>(n);
+}
+
+std::string Proxy::metrics_json() {
+  // gauges read the live pool state at scrape time; counters are already
+  // maintained inline
+  metrics_.sessions_active = static_cast<uint64_t>(
+      live_sessions_.load() > 0 ? live_sessions_.load() : 0);
+  {
+    std::lock_guard<Mutex> g(queue_mu_);
+    metrics_.sessions_queue_depth = accept_queue_.size();
+  }
+  return metrics_.json();
+}
+
+// Overflow answer on the accept thread: the queue is full, so this
+// connection is told to back off instead of waiting unbounded (or worse,
+// spawning an unbounded thread). Written before reading the request —
+// an early response to an overloaded server is valid HTTP, and reading
+// first would make the accept thread hostage to a slow client.
+void Proxy::reject_overflow(int cfd) {
+  metrics_.sessions_rejected++;
+  static const char resp[] =
+      "HTTP/1.1 503 Service Unavailable\r\n"
+      "Retry-After: 1\r\n"
+      "Content-Type: text/plain\r\n"
+      "Content-Length: 31\r\n"
+      "Connection: close\r\n\r\n"
+      "session pool saturated; retry\r\n";
+  // best-effort: a short send into a fresh socket buffer; SO_SNDTIMEO is
+  // already armed, so a dead peer cannot wedge the accept loop
+  (void)!::send(cfd, resp, sizeof resp - 1, MSG_NOSIGNAL);
+  ::shutdown(cfd, SHUT_WR);
+  // Lingering close: close() with unread received data emits RST, which
+  // discards the client's un-read 503 — exactly the "silent drop" the
+  // flood contract forbids. Drain to a 50 ms deadline in 5 ms polls: a
+  // client whose request send was descheduled past the first poll (200
+  // flooding threads on one CPU, sanitizer slowdowns) still lands its
+  // bytes inside the window; a well-behaved client's FIN (recv 0) or
+  // post-request quiet ends the wait early. Worst case (silent client
+  // that never closes) costs the full 50 ms, bounding the accept
+  // thread's serialized reject rate at ~20/s — the deep listen backlog
+  // absorbs bursts beyond that while the 503s drain.
+  struct pollfd pfd = {cfd, POLLIN, 0};
+  char drain[8192];
+  bool seen = false;
+  for (int elapsed = 0; elapsed < 50; elapsed += 5) {
+    if (::poll(&pfd, 1, 5) > 0 && (pfd.revents & POLLIN)) {
+      ssize_t n;
+      while ((n = ::recv(cfd, drain, sizeof drain, MSG_DONTWAIT)) > 0) {
+      }
+      if (n == 0) break;  // client FIN: everything sent is drained
+      seen = true;
+    } else if (seen) {
+      break;  // request landed and the client went quiet
+    }
+  }
+  ::close(cfd);
+}
+
+// One pool worker: pop an accepted fd, run its whole session (including
+// keep-alive request cycles) on this reused stack, repeat. Exits when
+// stop() flips running_ and the queue is drained.
+void Proxy::worker_loop() {
+  for (;;) {
+    int cfd = -1;
+    {
+      std::unique_lock<Mutex> lk(queue_mu_);
+      queue_cv_.wait(lk, [&] { return !running_ || !accept_queue_.empty(); });
+      if (!accept_queue_.empty()) {
+        cfd = accept_queue_.front();
+        accept_queue_.pop_front();
+        // count the claim while still holding queue_mu_: stop() must not
+        // observe live_sessions_==0 between this pop and the Session
+        // registration, or it would skip the force-close wait and block
+        // in the worker join behind a session nothing ever unblocks
+        live_sessions_++;
+      } else if (!running_) {
+        return;
+      } else {
+        continue;
+      }
+    }
+    {
+      Session s(this, cfd);
+      s.run();
+    }
+    live_sessions_--;
+  }
+}
+
 int Proxy::start() {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -errno;
@@ -1944,8 +2083,12 @@ int Proxy::start() {
   addr.sin_port = htons(static_cast<uint16_t>(cfg_.port));
   if (::inet_pton(AF_INET, cfg_.host.c_str(), &addr.sin_addr) != 1)
     addr.sin_addr.s_addr = INADDR_ANY;
+  // deep listen backlog: rejects are answered serially on the accept
+  // thread (each costs up to one short lingering-close poll), so the
+  // kernel queue must absorb flood bursts while 503s drain — a 128-entry
+  // backlog would time out the excess instead of backpressuring it
   if (::bind(fd, reinterpret_cast<struct sockaddr *>(&addr), sizeof addr) != 0 ||
-      ::listen(fd, 128) != 0) {
+      ::listen(fd, 1024) != 0) {
     int e = errno;
     ::close(fd);
     return -e;
@@ -1954,7 +2097,24 @@ int Proxy::start() {
   ::getsockname(fd, reinterpret_cast<struct sockaddr *>(&addr), &alen);
   port_ = ntohs(addr.sin_port);
   listen_fd_ = fd;
+
+  // resolve the executor shape: explicit config wins, then env, then the
+  // affinity-aware default (2× CPUs: serve work is sendfile/splice-bound,
+  // so a bit of oversubscription keeps the link busy across blocking IO)
+  session_threads_ = cfg_.session_threads > 0 ? cfg_.session_threads
+                                              : env_pos_int("DEMODEL_PROXY_THREADS");
+  if (session_threads_ <= 0) session_threads_ = 2 * available_cpus();
+  if (session_threads_ > 4096) session_threads_ = 4096;
+  int qcap = cfg_.session_queue > 0 ? cfg_.session_queue
+                                    : env_pos_int("DEMODEL_PROXY_QUEUE");
+  if (qcap <= 0) qcap = std::max(16, 4 * session_threads_);
+  session_queue_cap_ = static_cast<size_t>(qcap);
+
   running_ = true;
+  workers_.reserve(static_cast<size_t>(session_threads_));
+  for (int i = 0; i < session_threads_; i++)
+    workers_.emplace_back([this] { worker_loop(); });
+
   accept_thread_ = std::thread([this] {
     while (running_) {
       int cfd = ::accept(listen_fd_, nullptr, nullptr);
@@ -1967,14 +2127,18 @@ int Proxy::start() {
       ::setsockopt(cfd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
       int one2 = 1;
       ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof one2);
-      live_sessions_++;
-      std::thread([this, cfd] {
-        {
-          Session s(this, cfd);
-          s.run();
+      bool queued = false;
+      {
+        std::lock_guard<Mutex> g(queue_mu_);
+        if (accept_queue_.size() < session_queue_cap_) {
+          accept_queue_.push_back(cfd);
+          queued = true;
         }
-        live_sessions_--;
-      }).detach();
+      }
+      if (queued)
+        queue_cv_.notify_one();
+      else
+        reject_overflow(cfd);
     }
   });
   return 0;
@@ -1991,9 +2155,22 @@ void Proxy::stop() {
     ::close(fd);
     listen_fd_ = -1;
   }
+  // queued-but-unserved connections are closed, not served: shutdown
+  // truncates the backlog the same way the kernel drops its SYN backlog
+  {
+    std::lock_guard<Mutex> g(queue_mu_);
+    for (int qfd : accept_queue_) {
+      ::shutdown(qfd, SHUT_RDWR);
+      ::close(qfd);
+    }
+    accept_queue_.clear();
+  }
+  queue_cv_.notify_all();
   // force live sessions' blocking IO to fail, then wait for ALL of them —
   // the destructor frees state (store_, cfg_) that session threads use, so
-  // returning early here would be a use-after-free
+  // returning early here would be a use-after-free. Workers observe
+  // running_==false + empty queue and exit; the join below is the
+  // no-thread-leaks guarantee.
   {
     std::lock_guard<Mutex> g(sessions_mu_);
     for (Session *s : sessions_) s->force_close();
@@ -2003,6 +2180,9 @@ void Proxy::stop() {
     std::lock_guard<Mutex> g(sessions_mu_);
     for (Session *s : sessions_) s->force_close();  // catch late registrants
   }
+  for (auto &w : workers_)
+    if (w.joinable()) w.join();
+  workers_.clear();
 }
 
 // ---------------------------------------------------------- peer fetch
@@ -2421,7 +2601,8 @@ void *dm_proxy_new(const char *host, int port, int mitm_all, int no_mitm,
                    int verbose, int io_timeout_sec, int64_t max_body_mb,
                    int64_t cache_max_mb, int ranged_fill,
                    int64_t fill_max_mb, int fill_min_pct,
-                   int challenge_ttl_sec) {
+                   int challenge_ttl_sec, int session_threads,
+                   int session_queue) {
   dm::ProxyConfig cfg;
   cfg.host = host ? host : "127.0.0.1";
   cfg.port = port;
@@ -2450,6 +2631,8 @@ void *dm_proxy_new(const char *host, int port, int mitm_all, int no_mitm,
   if (fill_max_mb >= 0) cfg.fill_max_bytes = fill_max_mb << 20;
   if (fill_min_pct >= 0) cfg.fill_min_cover_pct = fill_min_pct;
   if (challenge_ttl_sec >= 0) cfg.challenge_ttl_sec = challenge_ttl_sec;
+  if (session_threads > 0) cfg.session_threads = session_threads;
+  if (session_queue > 0) cfg.session_queue = session_queue;
   return new dm::Proxy(std::move(cfg));
 }
 
@@ -2572,7 +2755,7 @@ int64_t dm_upstream_fetch_parallel(void *store, const char *host, int port,
 }
 
 int dm_proxy_metrics(void *p, char *buf, int buflen) {
-  std::string j = static_cast<dm::Proxy *>(p)->metrics().json();
+  std::string j = static_cast<dm::Proxy *>(p)->metrics_json();
   if (buf && buflen > 0) {
     int n = static_cast<int>(j.size());
     if (n >= buflen) n = buflen - 1;

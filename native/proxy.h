@@ -14,6 +14,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <deque>
 #include <memory>
 #include <mutex>
 #include <set>
@@ -56,12 +57,29 @@ struct ProxyConfig {
   // this long; while upstream is unreachable the stale copy still replays
   // (offline-first). 0 = never expire (ADVICE r3 low).
   int challenge_ttl_sec = 86400;
+  // Bounded session executor (serve-plane scalability): a fixed worker
+  // pool pulls accepted connections from a bounded queue instead of
+  // spawning a detached thread per connection — a connection flood must
+  // degrade into clean 503s, not thread-bomb the host. 0 = auto: env
+  // DEMODEL_PROXY_THREADS, else 2×available CPUs (the same affinity-aware
+  // convention as the Python side's _peer_streams()). Explicit value wins.
+  int session_threads = 0;
+  // accept-queue bound; 0 = auto: env DEMODEL_PROXY_QUEUE, else
+  // max(16, 4×session_threads). Overflow is answered 503 + Retry-After.
+  int session_queue = 0;
 };
 
 struct Metrics {
   std::atomic<uint64_t> connects{0}, mitm{0}, tunnel{0}, requests{0},
       cache_hits{0}, cache_misses{0}, bytes_up{0}, bytes_down{0},
       bytes_cache{0}, errors{0};
+  // serve-plane executor: *_active/*_queue_depth are gauges (refreshed by
+  // Proxy::metrics_json from the live pool state), the rest are counters.
+  // serve_bytes_total counts every body byte served to clients out of the
+  // local store (peer index/meta/object, tensor windows, cached replays,
+  // fill-attach) — the hot-hit delivery volume.
+  std::atomic<uint64_t> sessions_active{0}, sessions_queue_depth{0},
+      sessions_rejected{0}, serve_bytes{0};
   std::string json() const;
 };
 
@@ -104,10 +122,14 @@ class Proxy {
   Proxy(const Proxy &) = delete;
   Proxy &operator=(const Proxy &) = delete;
 
-  int start();  // bind+listen+accept thread; 0 or -errno
-  void stop();  // joins accept thread, force-closes live sessions
+  int start();  // bind+listen, accept thread + session worker pool; 0 or -errno
+  void stop();  // joins accept thread + workers, force-closes live sessions
   int port() const { return port_; }
   Metrics &metrics() { return metrics_; }
+  // metrics JSON with the pool gauges (sessions_active/queue_depth)
+  // refreshed from live state — what /metrics and dm_proxy_metrics serve
+  std::string metrics_json();
+  int session_threads() const { return session_threads_; }
 
   bool should_mitm(const std::string &authority) const;
   SSL_CTX *leaf_ctx(const std::string &host, std::string *err);
@@ -167,6 +189,19 @@ class Proxy {
   int port_ = 0;
   std::thread accept_thread_;
   std::atomic<uint64_t> gc_tick_{0};
+
+  // bounded session executor: accept thread pushes client fds, the fixed
+  // worker pool pops them; overflow never reaches the queue (503'd on the
+  // accept thread). queue_mu_ is rank-checked like every other member
+  // mutex (condition_variable_any works over the ranked mutex).
+  void worker_loop();
+  void reject_overflow(int cfd);
+  Mutex queue_mu_{kRankProxyQueue};
+  std::condition_variable_any queue_cv_;
+  std::deque<int> accept_queue_;
+  std::vector<std::thread> workers_;
+  int session_threads_ = 0;   // resolved pool size (start())
+  size_t session_queue_cap_ = 0;
 };
 
 }  // namespace dm

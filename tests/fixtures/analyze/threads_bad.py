@@ -1,0 +1,43 @@
+"""Golden fixture for unjoined-thread: started-and-forgotten threads."""
+
+import threading
+
+
+def fire_and_forget(work):
+    threading.Thread(target=work).start()
+
+
+def started_never_joined(work):
+    t = threading.Thread(target=work)
+    t.start()
+    return None
+
+
+def ok_daemon(work):
+    threading.Thread(target=work, daemon=True).start()
+
+
+def ok_joined(work):
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+
+
+def ok_tracked_in_list(work):
+    ts = []
+    for _ in range(4):
+        ts.append(threading.Thread(target=work))
+    for t in ts:
+        t.start()
+    return ts
+
+
+class OkSelfTracked:
+    def spawn(self, work):
+        self._worker = threading.Thread(target=work)
+        self._worker.start()
+
+
+def ok_never_started(work):
+    t = threading.Thread(target=work)  # handed to a caller that starts it
+    return t

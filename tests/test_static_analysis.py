@@ -35,6 +35,7 @@ ALL_RULES = {
     "lock-order",
     "log-hygiene",
     "peer-json-shape",
+    "unjoined-thread",
 }
 
 #: fixture file → exact expected (rule, line) findings
@@ -73,6 +74,10 @@ GOLDEN = {
     "json_shape_bad.py": {
         ("peer-json-shape", 10),
         ("peer-json-shape", 11),
+    },
+    "threads_bad.py": {
+        ("unjoined-thread", 7),
+        ("unjoined-thread", 11),
     },
 }
 

@@ -9,4 +9,5 @@ from tools.analyze.passes import (  # noqa: F401 — registration imports
     lock_io,
     lock_order,
     log_hygiene,
+    threads,
 )

@@ -1,0 +1,134 @@
+"""unjoined-thread: flag ``threading.Thread`` objects that are started but
+neither joined, tracked, nor daemonized.
+
+First of ROADMAP's "async-cancellation safety" rules: a fire-and-forget
+thread outlives the error path that spawned it — ``stop()``/teardown can't
+drain it, sanitizers can't see past its detach, and under load it is the
+thread-bomb shape the serve plane's bounded executor exists to prevent.
+
+A started thread is considered OWNED (no finding) when, in the same scope,
+it is any of:
+
+- constructed with ``daemon=True`` (the runtime reaps it at exit);
+- ``.join()``-ed, or has ``.daemon`` assigned before start;
+- stored: assigned to an attribute (``self._worker = t``), passed to a
+  call (``threads.append(t)``, ``registry.track(t)``), placed in a
+  list/tuple/dict/set literal or comprehension, returned, or yielded —
+  ownership moved somewhere that can join it later.
+
+Deliberate fire-and-forget (rare, justified) gets an inline
+``# demodel: allow(unjoined-thread)`` with a why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analyze.core import (
+    Finding,
+    ModuleContext,
+    Pass,
+    dotted,
+    enclosing_function,
+    register,
+    walk_in_scope,
+)
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    name = dotted(node.func)
+    return name is not None and (name == "Thread" or name.endswith(".Thread"))
+
+
+def _has_daemon_true(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _scope_of(node: ast.AST, ctx: ModuleContext) -> ast.AST:
+    fn = enclosing_function(node)
+    return fn if fn is not None else ctx.tree
+
+
+def _name_events(scope: ast.AST, name: str) -> dict[str, bool]:
+    """How a local thread variable is used inside ``scope``."""
+    ev = {"started": False, "owned": False}
+    for sub in walk_in_scope(scope):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            recv = sub.func.value
+            if isinstance(recv, ast.Name) and recv.id == name:
+                if sub.func.attr == "start":
+                    ev["started"] = True
+                if sub.func.attr == "join":
+                    ev["owned"] = True
+        if isinstance(sub, ast.Call):
+            # passed somewhere (threads.append(t), pool.track(t), ...):
+            # ownership handed off
+            for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    ev["owned"] = True
+        if isinstance(sub, ast.Assign):
+            # self._worker = t / registry["x"] = t → tracked;
+            # t.daemon = True → reaped at exit
+            for tgt in sub.targets:
+                if (isinstance(tgt, (ast.Attribute, ast.Subscript))
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == name):
+                    ev["owned"] = True
+                if (isinstance(tgt, ast.Attribute) and tgt.attr == "daemon"
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == name):
+                    ev["owned"] = True
+        if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+            val = sub.value
+            if isinstance(val, ast.Name) and val.id == name:
+                ev["owned"] = True
+            if isinstance(val, (ast.Tuple, ast.List)):
+                for elt in val.elts:
+                    if isinstance(elt, ast.Name) and elt.id == name:
+                        ev["owned"] = True
+    return ev
+
+
+@register
+class UnjoinedThreadPass(Pass):
+    id = "unjoined-thread"
+    description = (
+        "threading.Thread started but never joined, tracked, or daemonized "
+        "(orphaned on error paths; unbounded under load)"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not _is_thread_ctor(node):
+                continue
+            if _has_daemon_true(node):
+                continue
+            parent = getattr(node, "_dm_parent", None)
+            # Thread(...).start() — fire-and-forget, nothing ever owns it
+            if (isinstance(parent, ast.Attribute) and parent.attr == "start"
+                    and isinstance(getattr(parent, "_dm_parent", None),
+                                   ast.Call)):
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    "Thread(...).start() without join/daemon/tracking — "
+                    "orphaned on error paths",
+                )
+                continue
+            # t = Thread(...): require join/track/daemon for a started t
+            if isinstance(parent, ast.Assign):
+                tgts = parent.targets
+                if len(tgts) == 1 and isinstance(tgts[0], ast.Name):
+                    ev = _name_events(_scope_of(node, ctx), tgts[0].id)
+                    if ev["started"] and not ev["owned"]:
+                        yield Finding(
+                            ctx.rel, node.lineno, self.id,
+                            f"thread '{tgts[0].id}' is start()ed but never "
+                            "joined, tracked, or daemonized",
+                        )
+                # assignment to an attribute/subscript target is tracking
+            # any other context (call argument, collection literal,
+            # comprehension, return) moves ownership — no finding

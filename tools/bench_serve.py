@@ -1,0 +1,330 @@
+"""Serve-plane benchmark driver — prints ONE JSON line (same contract as
+the delivery-side ``bench.py``; that driver times cold-pull→HBM, this one
+times the OTHER half of the system: re-serving cached blobs to many
+clients, the reference's whole value proposition).
+
+Scenario: a loopback proxy node over a warmed content-addressed store,
+``DEMODEL_SERVE_CLIENTS`` concurrent keep-alive clients hammering the
+hot-hit endpoints —
+
+  object   ``GET /peer/object/{key}`` full-body hits (the sendfile path);
+           the headline metric is this leg's MB/s;
+  meta     ``GET /peer/meta/{key}`` small-JSON hits;
+  index    ``GET /peer/index`` generation-cached store index.
+
+Each leg reports reqs/s and p50/p99 latency; the object leg adds MB/s.
+
+A separate **flood leg** restarts the proxy with ``DEMODEL_PROXY_THREADS=4``
+and opens connections ≫ pool+queue, asserting the bounded-session-executor
+contract: process thread count stays at pool + constant, overflow is
+answered ``503 + Retry-After`` (never silently dropped), and every
+connection gets a response. On a pre-pool (detach-per-connection) build the
+flood leg still runs but only reports — ``flood_ok`` is null there.
+
+Env knobs: DEMODEL_SERVE_OBJ_MB (default 8), DEMODEL_SERVE_OBJECTS (4),
+DEMODEL_SERVE_CLIENTS (8), DEMODEL_SERVE_SECS (3.0), DEMODEL_SERVE_FLOOD
+(200). ``--smoke`` (or DEMODEL_SERVE_SMOKE=1) shrinks everything for CI.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _env_f(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+SMOKE = ("--smoke" in sys.argv
+         or os.environ.get("DEMODEL_SERVE_SMOKE", "").strip() == "1")
+OBJ_MB = int(_env_f("DEMODEL_SERVE_OBJ_MB", 1 if SMOKE else 8))
+N_OBJECTS = int(_env_f("DEMODEL_SERVE_OBJECTS", 2 if SMOKE else 4))
+N_CLIENTS = int(_env_f("DEMODEL_SERVE_CLIENTS", 4 if SMOKE else 8))
+LEG_SECS = _env_f("DEMODEL_SERVE_SECS", 1.0 if SMOKE else 3.0)
+FLOOD_CONNS = int(_env_f("DEMODEL_SERVE_FLOOD", 48 if SMOKE else 200))
+FLOOD_THREADS = 4  # the acceptance-criteria pool size
+
+
+def _proc_threads() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("Threads:"):
+                return int(line.split()[1])
+    return -1
+
+
+def _percentile(sorted_vals: list[float], pct: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(pct / 100 * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _node(tmp: Path):
+    from demodel_tpu.config import ProxyConfig
+    from demodel_tpu.proxy import ProxyServer
+
+    cfg = ProxyConfig(
+        host="127.0.0.1", port=0, mitm_hosts=[], no_mitm=True,
+        cache_dir=tmp / "cache", data_dir=tmp / "data", use_ecdsa=True,
+    )
+    return ProxyServer(cfg, verbose=False)
+
+
+def _warm_store(cache_dir: Path, n: int, mb: int) -> list[str]:
+    """Put n objects of mb MB each straight into the node's store root."""
+    from demodel_tpu.store import Store
+
+    keys = []
+    s = Store(cache_dir / "proxy")
+    try:
+        body = os.urandom(1 << 20) * mb  # mb MB, incompressible enough
+        for i in range(n):
+            key = f"servebench{i:06d}"
+            s.put(key, body, {"content-type": "application/octet-stream"})
+            keys.append(key)
+    finally:
+        s.close()
+    return keys
+
+
+def _hammer(port: int, path_for, secs: float, clients: int,
+            expect_body: bool) -> tuple[int, int, list[float]]:
+    """``clients`` keep-alive connections looping GETs for ``secs``.
+
+    Returns (requests_completed, bytes_received, latencies_sec)."""
+    stop = time.perf_counter() + secs
+    lock = threading.Lock()
+    total_reqs = 0
+    total_bytes = 0
+    lats: list[float] = []
+    errors: list[BaseException] = []  # re-raised in main: a worker dying
+    # silently would deflate reqs/s and still exit 0 (the CI smoke's only
+    # guard is value>0, so swallowed failures must surface here)
+
+    def worker(wid: int) -> None:
+        nonlocal total_reqs, total_bytes
+        reqs = 0
+        nbytes = 0
+        mine: list[float] = []
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            i = 0
+            while time.perf_counter() < stop:
+                t0 = time.perf_counter()
+                conn.request("GET", path_for(wid, i))
+                resp = conn.getresponse()
+                body = resp.read()
+                mine.append(time.perf_counter() - t0)
+                if resp.status != 200:
+                    raise AssertionError(
+                        f"hot hit returned {resp.status} on {path_for(wid, i)}")
+                if expect_body and not body:
+                    raise AssertionError("empty hot-hit body")
+                reqs += 1
+                nbytes += len(body)
+                i += 1
+        except BaseException as e:  # noqa: BLE001 — reported by the caller
+            with lock:
+                errors.append(e)
+        finally:
+            conn.close()
+            with lock:
+                total_reqs += reqs
+                total_bytes += nbytes
+                lats.extend(mine)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return total_reqs, total_bytes, sorted(lats)
+
+
+def _leg(name: str, port: int, path_for, secs: float, clients: int,
+         expect_body: bool) -> dict:
+    reqs, nbytes, lats = _hammer(port, path_for, secs, clients, expect_body)
+    out = {
+        f"{name}_reqs_s": round(reqs / secs, 1),
+        f"{name}_p50_ms": round(_percentile(lats, 50) * 1e3, 3),
+        f"{name}_p99_ms": round(_percentile(lats, 99) * 1e3, 3),
+    }
+    if expect_body:
+        out[f"{name}_mb_s"] = round(nbytes / 1e6 / secs, 2)
+    print(f"[bench_serve] {name}: {reqs} reqs in {secs:.1f}s "
+          f"({out[f'{name}_reqs_s']}/s, p50={out[f'{name}_p50_ms']}ms, "
+          f"p99={out[f'{name}_p99_ms']}ms)", file=sys.stderr)
+    return out
+
+
+def _flood(tmp: Path) -> dict:
+    """Connections ≫ pool: every one must get a 200 or a 503+Retry-After,
+    and the process must not grow a thread per connection."""
+    key = _warm_store(tmp / "flood-node" / "cache", 1, 1)[0]
+    os.environ["DEMODEL_PROXY_THREADS"] = str(FLOOD_THREADS)
+    try:
+        node = _node(tmp / "flood-node").start()
+    finally:
+        del os.environ["DEMODEL_PROXY_THREADS"]
+    try:
+        # the pool exists iff the native metrics carry the serve counters
+        pooled = "sessions_rejected_total" in node.metrics()
+        base_threads = _proc_threads()
+        peak = {"threads": base_threads}
+        results = []  # per-connection: ("200"|"503"|"err", retry_after_seen)
+        rlock = threading.Lock()
+        start_gate = threading.Barrier(FLOOD_CONNS + 1)
+
+        def one_conn() -> None:
+            outcome, retry_after = "err", False
+            try:
+                start_gate.wait(timeout=60)
+                conn = http.client.HTTPConnection("127.0.0.1", node.port,
+                                                  timeout=60)
+                try:
+                    conn.request("GET", f"/peer/object/{key}",
+                                 headers={"Connection": "close"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    outcome = str(resp.status)
+                    retry_after = resp.getheader("Retry-After") is not None
+                finally:
+                    conn.close()
+            except Exception as e:  # noqa: BLE001 — recorded as a drop
+                outcome = f"err:{type(e).__name__}"
+            with rlock:
+                results.append((outcome, retry_after))
+
+        threads = [threading.Thread(target=one_conn)
+                   for _ in range(FLOOD_CONNS)]
+        for t in threads:
+            t.start()
+        start_gate.wait(timeout=60)  # release the whole burst at once
+        # sample thread count while the burst is in flight
+        for _ in range(50):
+            peak["threads"] = max(peak["threads"], _proc_threads())
+            time.sleep(0.02)
+        for t in threads:
+            t.join()
+        peak["threads"] = max(peak["threads"], _proc_threads())
+    finally:
+        node.stop()
+
+    served = sum(1 for o, _ in results if o == "200")
+    rejected = sum(1 for o, _ in results if o == "503")
+    rejected_with_retry = sum(1 for o, ra in results if o == "503" and ra)
+    dropped = sum(1 for o, _ in results if o not in ("200", "503"))
+    if dropped:
+        kinds: dict[str, int] = {}
+        for o, _ in results:
+            if o not in ("200", "503"):
+                kinds[o] = kinds.get(o, 0) + 1
+        print(f"[bench_serve] flood drops by kind: {kinds}", file=sys.stderr)
+    # the boundedness assertion: proxy-side threads beyond the flood
+    # clients' own. Client threads account for FLOOD_CONNS of the delta;
+    # the pooled proxy may add pool + accept + a small constant, while the
+    # detach build adds a thread per in-flight connection.
+    proxy_extra = peak["threads"] - base_threads - FLOOD_CONNS
+    flood = {
+        "conns": FLOOD_CONNS,
+        "pool_threads": FLOOD_THREADS,
+        "served": served,
+        "rejected_503": rejected,
+        "rejected_with_retry_after": rejected_with_retry,
+        "dropped_silently": dropped,
+        "proxy_extra_threads": proxy_extra,
+        "pooled": pooled,
+    }
+    if pooled:
+        flood["flood_ok"] = (
+            dropped == 0
+            and served + rejected == FLOOD_CONNS
+            and rejected == rejected_with_retry
+            and proxy_extra <= FLOOD_THREADS + 8
+        )
+    else:
+        flood["flood_ok"] = None  # detach baseline: report-only
+    print(f"[bench_serve] flood: {flood}", file=sys.stderr)
+    return flood
+
+
+def main() -> int:
+    t_setup = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        keys = _warm_store(tmp / "node" / "cache", N_OBJECTS, OBJ_MB)
+        # the measured leg gets an explicit pool ≥ clients so keep-alive
+        # clients never queue behind each other — the comparison against
+        # the detach build is then socket-for-socket fair
+        os.environ["DEMODEL_PROXY_THREADS"] = str(max(N_CLIENTS, 2))
+        try:
+            node = _node(tmp / "node").start()
+        finally:
+            del os.environ["DEMODEL_PROXY_THREADS"]
+        try:
+            port = node.port
+            print(f"[bench_serve] node up on :{port} after "
+                  f"{time.perf_counter() - t_setup:.2f}s "
+                  f"({N_OBJECTS}×{OBJ_MB} MB warmed)", file=sys.stderr)
+            # one warmup pass per endpoint (open fds, fault the page cache)
+            _hammer(port, lambda w, i: f"/peer/object/{keys[0]}", 0.2, 2, True)
+
+            out: dict = {}
+            out.update(_leg(
+                "object", port,
+                lambda w, i: f"/peer/object/{keys[(w + i) % len(keys)]}",
+                LEG_SECS, N_CLIENTS, expect_body=True))
+            out.update(_leg(
+                "meta", port,
+                lambda w, i: f"/peer/meta/{keys[(w + i) % len(keys)]}",
+                LEG_SECS / 2, N_CLIENTS, expect_body=True))
+            out.update(_leg(
+                "index", port, lambda w, i: "/peer/index",
+                LEG_SECS / 2, N_CLIENTS, expect_body=True))
+            native = node.metrics()
+        finally:
+            node.stop()
+
+        flood = _flood(tmp)
+
+    result = {
+        "metric": "serve_hot_hit_throughput",
+        "value": out["object_mb_s"],
+        "unit": "MB/s",
+        "vs_baseline": 0.0,  # first serve-plane datapoint — no prior anchor
+        "clients": N_CLIENTS,
+        "objects": N_OBJECTS,
+        "object_mb": OBJ_MB,
+        "pooled": flood.get("pooled", False),
+        **out,
+        "flood": flood,
+        **({"native_serve_bytes_total": native["serve_bytes_total"]}
+           if "serve_bytes_total" in native else {}),
+    }
+    print(json.dumps(result))
+    if flood["flood_ok"] is False:
+        print("[bench_serve] FLOOD CONTRACT VIOLATED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
