@@ -194,6 +194,42 @@ def _peer_alive(peer: str, timeout: float = 3.0) -> bool:
         return False
 
 
+def _alive_peers(peers: list, timeout: float = 3.0) -> list:
+    """Probe every candidate peer CONCURRENTLY under one shared deadline.
+
+    The striping rotation used to probe candidates one at a time: K
+    stale peer URLs on the pull critical path cost K × timeout before
+    the first byte moved. Here each probe rides ``asyncio.to_thread``
+    and the whole rotation build is bounded by ~timeout: stragglers are
+    cancelled at the deadline (on every exit path — the
+    ``orphaned-async-task`` discipline) and treated as dead. Their probe
+    threads may run on to their socket timeout; ``asyncio.run`` joins
+    them at loop shutdown, so nothing leaks — worst case is ~2×timeout
+    total, independent of peer count.
+    """
+    if not peers:
+        return []
+    import asyncio
+
+    async def _probe_all() -> list:
+        tasks = {
+            p: asyncio.create_task(asyncio.to_thread(_peer_alive, p, timeout))
+            for p in peers
+        }
+        done: set = set()
+        try:
+            done, _pending = await asyncio.wait(
+                set(tasks.values()), timeout=timeout + 0.5)
+        finally:
+            for t in tasks.values():
+                t.cancel()  # no-op on done tasks; orphans none on errors
+        return [p for p, t in tasks.items()
+                if t in done and not t.cancelled()
+                and t.exception() is None and t.result()]
+
+    return asyncio.run(_probe_all())
+
+
 def _reader_and_index(f: dict, peer_order: list[str], streams):
     """Open ``f`` on the first peer that can serve its safetensors index
     (header reads fail over; window reads during delivery are handled by
@@ -245,6 +281,7 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
 
     from demodel_tpu.formats.safetensors import _np_dtype
     from demodel_tpu.sink.hbm import place_tensor
+    from demodel_tpu.sink.streaming import ByteBudget
 
     if prefetch_depth is None:
         # prefetch overlap needs either a SPARE core or a transfer that
@@ -267,11 +304,44 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
         prefetch_depth = env_int(
             "DEMODEL_SINK_PREFETCH", default_depth, minimum=0)
     out = Placement(mesh_desc=f"{dict(mesh.shape)}")
+    # landing buffers are charged to the SAME byte budget the streaming
+    # sink enforces (DEMODEL_SINK_BUFFER_MB): before this, prefetch
+    # workers could pin depth × tensor bytes of host RAM with no bound —
+    # the accounting gap the hbm-budget analyzer rule flags
+    budget = ByteBudget(env_int("DEMODEL_SINK_BUFFER_MB", 1024,
+                                minimum=1) << 20)
 
-    def fetch(job):
+    # FIFO admission tickets: budget grants MUST follow job order. The
+    # main loop consumes futures in order, so if a later window could
+    # win capacity freed for an earlier one, the three-way wait closes:
+    # main blocks on the earlier future, whose worker blocks in acquire,
+    # waiting for a release that only happens when main places the LATER
+    # buffer. With tickets, the head job is the only one in acquire, and
+    # everything it waits on is already in main's consume path.
+    admission = {"next": 0, "dead": False}
+    admit_cv = threading.Condition()
+
+    def fetch(job, idx):
         reader, key, _name, spec = job
-        buf = np.empty(spec.end - spec.start, dtype=np.uint8)
-        reader.pread_into(key, buf, spec.start)
+        nbytes = spec.end - spec.start
+        with admit_cv:
+            while admission["next"] != idx and not admission["dead"]:
+                admit_cv.wait()
+        try:
+            # charge before the bytes exist, so a worker blocks HERE
+            # rather than allocating past the budget; released after
+            # place()
+            budget.acquire(nbytes)
+        finally:
+            with admit_cv:
+                admission["next"] = idx + 1
+                admit_cv.notify_all()
+        try:
+            buf = np.empty(nbytes, dtype=np.uint8)
+            reader.pread_into(key, buf, spec.start)
+        except BaseException:
+            budget.release(nbytes)
+            raise
         return buf
 
     def place(buf, name, spec):
@@ -302,40 +372,61 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
     if prefetch_depth == 0:
         # thread-free: fetch inline, place, next — the fastest shape
         # when there is no core to hide the fetch on
-        for reader, key, name, spec in jobs:
+        for i, (reader, key, name, spec) in enumerate(jobs):
             t0 = time.perf_counter()
             try:
-                buf = fetch((reader, key, name, spec))
+                buf = fetch((reader, key, name, spec), i)
             except OSError as e:
                 raise PipelineFailure(e, out) from e
             t1 = time.perf_counter()
-            place(buf, name, spec)
+            try:
+                place(buf, name, spec)
+            finally:
+                budget.release(buf.nbytes)
             t2 = time.perf_counter()
             phases[fetch_key] += t1 - t0
             phases["place_secs"] += t2 - t1
         return out
 
     with ThreadPoolExecutor(max_workers=prefetch_depth) as ex:
-        pending = [ex.submit(fetch, j)
-                   for j in jobs[:prefetch_depth]]
-        for i, (reader, key, name, spec) in enumerate(jobs):
-            t0 = time.perf_counter()
-            try:
-                buf = pending.pop(0).result()
-            except OSError as e:
-                # surface WHAT already landed: placed tensors are final
-                # (their bytes are verified views of fetched windows) —
-                # the failover path resumes from them
-                for p in pending:
-                    p.cancel()
-                raise PipelineFailure(e, out) from e
-            t1 = time.perf_counter()
-            nxt = i + prefetch_depth
-            if nxt < len(jobs):
-                pending.append(ex.submit(fetch, jobs[nxt]))
-            place(buf, name, spec)
-            phases[fetch_key] += t1 - t0
-            phases["place_secs"] += time.perf_counter() - t1
+        # the try must live INSIDE the `with`: on an exception the
+        # executor's __exit__ joins its workers during unwinding, so a
+        # worker blocked in budget.acquire has to be woken by abort()
+        # BEFORE that join runs — an outer handler would run after it,
+        # i.e. after the deadlock
+        try:
+            pending = [ex.submit(fetch, j, d)
+                       for d, j in enumerate(jobs[:prefetch_depth])]
+            for i, (reader, key, name, spec) in enumerate(jobs):
+                t0 = time.perf_counter()
+                try:
+                    buf = pending.pop(0).result()
+                except OSError as e:
+                    # surface WHAT already landed: placed tensors are
+                    # final (their bytes are verified views of fetched
+                    # windows) — the failover path resumes from them
+                    for p in pending:
+                        p.cancel()
+                    raise PipelineFailure(e, out) from e
+                t1 = time.perf_counter()
+                nxt = i + prefetch_depth
+                if nxt < len(jobs):
+                    pending.append(ex.submit(fetch, jobs[nxt], nxt))
+                try:
+                    place(buf, name, spec)
+                finally:
+                    budget.release(buf.nbytes)
+                phases[fetch_key] += t1 - t0
+                phases["place_secs"] += time.perf_counter() - t1
+        except BaseException:
+            # in-flight buffers die with this call; their charges are
+            # moot. Wake BOTH wait states before the executor join:
+            # acquire-waiters via abort, ticket-waiters via "dead"
+            budget.abort()
+            with admit_cv:
+                admission["dead"] = True
+                admit_cv.notify_all()
+            raise
     return out
 
 
@@ -425,7 +516,7 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
     # restarts the pull pod-wide instead.
     if jax.process_count() == 1:
         others = [p.rstrip("/") for p in peers if p.rstrip("/") != peer]
-        peer_order = [peer] + [p for p in others if _peer_alive(p)]
+        peer_order = [peer] + _alive_peers(others)
     else:
         peer_order = [peer]
     weight_files = []

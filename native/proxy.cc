@@ -286,6 +286,8 @@ static int tcp_connect(const std::string &host, int port, int timeout_sec,
   ::snprintf(portbuf, sizeof portbuf, "%d", port);
   int rc = ::getaddrinfo(host.c_str(), portbuf, &hints, &res);
   if (rc != 0) {
+    // NOLINTNEXTLINE(concurrency-mt-unsafe) — glibc gai_strerror returns
+    // pointers into a static CONST table (MT-Safe per the glibc manual)
     if (err) *err = std::string("resolve ") + host + ": " + gai_strerror(rc);
     return -1;
   }
@@ -1973,6 +1975,9 @@ static int available_cpus() {
 // a fat-fingered value falls back to the computed default, same policy as
 // the Python side's env_int).
 static int env_pos_int(const char *name) {
+  // NOLINTNEXTLINE(concurrency-mt-unsafe) — read-only env access; nothing
+  // in this process calls setenv after startup (config is env-frozen by
+  // the Python launcher before any native thread exists)
   const char *v = ::getenv(name);
   if (!v || !*v) return 0;
   char *end = nullptr;
@@ -2287,7 +2292,7 @@ static int64_t peer_fetch_once(Store *store, const std::string &host, int port,
   int rc = w->commit(meta_json);
   delete w;
   if (rc != 0) {
-    if (err) *err = "commit failed: " + std::string(::strerror(-rc));
+    if (err) *err = "commit failed: " + dm_strerror(-rc);
     return -1;
   }
   return total;
@@ -2525,7 +2530,7 @@ int64_t peer_fetch_parallel(Store *store, const std::string &host, int port,
     return -1;
   }
   if (rc != 0) {
-    if (err) *err = "parallel commit failed: " + std::string(::strerror(-rc));
+    if (err) *err = "parallel commit failed: " + dm_strerror(-rc);
     return -1;
   }
   return total;
@@ -2583,7 +2588,7 @@ int64_t upstream_fetch_parallel(Store *store, const std::string &host,
     return -1;
   }
   if (rc != 0) {
-    if (err) *err = "parallel commit failed: " + std::string(::strerror(-rc));
+    if (err) *err = "parallel commit failed: " + dm_strerror(-rc);
     return -1;
   }
   return total;

@@ -290,7 +290,7 @@ Store *Store::open(const std::string &root, std::string *err) {
     }
     int rc = mkdir_p(p);
     if (rc != 0) {
-      if (err) *err = "mkdir " + p + ": " + ::strerror(-rc);
+      if (err) *err = "mkdir " + p + ": " + dm_strerror(-rc);
       return nullptr;
     }
   }
@@ -452,7 +452,7 @@ Writer *Store::begin(const std::string &key, bool resume, std::string *err) {
   int flags = O_WRONLY | O_CREAT | O_CLOEXEC | (resume ? O_APPEND : O_TRUNC);
   int fd = ::open(part_path(key).c_str(), flags, 0644);
   if (fd < 0) {
-    if (err) *err = std::string("open partial: ") + ::strerror(errno);
+    if (err) *err = std::string("open partial: ") + dm_strerror(errno);
     finish_writer(key);
     return nullptr;
   }
@@ -487,12 +487,12 @@ RangeWriter *Store::begin_ranged(const std::string &key, int64_t total,
   int fd = ::open(part_path(key).c_str(), O_RDWR | O_CREAT | O_TRUNC | O_CLOEXEC,
                   0644);
   if (fd < 0) {
-    if (err) *err = std::string("open partial: ") + ::strerror(errno);
+    if (err) *err = std::string("open partial: ") + dm_strerror(errno);
     finish_writer(key);
     return nullptr;
   }
   if (total > 0 && ::ftruncate(fd, total) != 0) {
-    if (err) *err = std::string("preallocate: ") + ::strerror(errno);
+    if (err) *err = std::string("preallocate: ") + dm_strerror(errno);
     ::close(fd);
     finish_writer(key);
     return nullptr;
@@ -858,7 +858,7 @@ void Store::pin(const std::string &key) {
       ::fprintf(stderr,
                 "[demodel-tpu] WARNING: pin marker %s failed (%s): other "
                 "processes' GC may evict this key while it is served\n",
-                pin_path(key).c_str(), ::strerror(errno));
+                pin_path(key).c_str(), dm_strerror(errno).c_str());
     }
     if (fd >= 0) {
       long long st = proc_starttime((long)::getpid());

@@ -16,6 +16,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <cstring>
 #include <map>
 #include <mutex>
 #include <set>
@@ -27,6 +28,25 @@
 namespace dm {
 
 class Store;
+
+// Thread-safe strerror. ::strerror's shared static buffer races across
+// the proxy's session workers and the store's commit threads
+// (concurrency-mt-unsafe); strerror_r is the fix, but GNU and XSI
+// flavors disagree on the signature — the overload pair picks at
+// compile time whichever this libc provides.
+namespace detail {
+inline const char *se_pick(int rc, const char *buf) {  // XSI: int return
+  return rc == 0 ? buf : "unknown error";
+}
+inline const char *se_pick(const char *ret, const char *) {  // GNU
+  return ret;
+}
+}  // namespace detail
+
+inline std::string dm_strerror(int errnum) {
+  char buf[128] = {0};
+  return detail::se_pick(::strerror_r(errnum, buf, sizeof buf), buf);
+}
 
 // 16-hex key: first 8 bytes of sha256(uri) — mirrored by the Python
 // key_for_uri (tests/test_store.py::test_key_matches_native).

@@ -1,0 +1,37 @@
+"""Golden fixture: orphaned-async-task. Never imported — parsed only by
+tools.analyze in tests."""
+import asyncio
+
+
+async def fire_and_forget(work):
+    asyncio.create_task(work())                  # line 7: reference discarded
+
+
+async def never_awaited(work):
+    t = asyncio.create_task(work())              # line 11: nothing owns t
+    return None
+
+
+async def error_path(work, publish):
+    t = asyncio.create_task(work())
+    await publish()                              # line 17: raise orphans t
+    return await t
+
+
+async def ok_gathered(work):
+    t1 = asyncio.create_task(work())
+    t2 = asyncio.create_task(work())
+    return await asyncio.gather(t1, t2)
+
+
+async def ok_error_path(work, publish):
+    t = asyncio.create_task(work())
+    try:
+        await publish()
+    finally:
+        t.cancel()
+    return await t
+
+
+async def ok_stored(work, registry):
+    registry["w"] = asyncio.create_task(work())

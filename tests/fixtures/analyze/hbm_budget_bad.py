@@ -1,0 +1,47 @@
+# demodel: sink-plane
+"""Golden fixture: hbm-budget — device allocations that bypass the
+sharding plan and the ByteBudget. Never imported — parsed only by
+tools.analyze in tests."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def land_unplaced(arr):
+    return jax.device_put(arr)                   # line 12: no placement at all
+
+
+def land_off_plan(arr, devices):
+    return jax.device_put(arr, devices[0])       # line 16: not plan-derived
+
+
+def scratch(n):
+    return jnp.zeros((n, n))                     # line 20: unplanned jnp alloc
+
+
+def deliver(jobs, ex, reader):
+    def fetch(spec):
+        buf = np.empty(spec.nbytes, dtype=np.uint8)   # line 25: unbudgeted
+        reader.pread_into(spec.key, buf, spec.start)  # concurrent buffer
+        return buf
+
+    return [ex.submit(fetch, s) for s in jobs]
+
+
+def helper(arr, sharding):
+    # accounted: ok_caller below proves the plan threads through
+    return jax.device_put(arr, sharding)
+
+
+def ok_caller(arr, plan):
+    return helper(arr, plan.sharding_for("w", arr.shape, 4))
+
+
+def bad_caller(arr, target):
+    return helper(arr, target)                   # line 42: contract break
+
+
+def ok_planned(arr, plan, name):
+    sharding = plan.sharding_for(name, arr.shape, arr.itemsize)
+    return jax.device_put(arr, sharding)         # plan-derived: no finding

@@ -1,0 +1,20 @@
+# demodel: hot-path
+"""Cross-module taint pair, consumer half: device values produced in
+taint_producer.py are synced HERE — invisible to single-module analysis,
+caught when both files share one ProjectIndex (analyzed together).
+Never imported — parsed only by tools.analyze in tests."""
+import numpy as np
+
+from tests.fixtures.analyze.taint_producer import make_scale, make_table
+
+
+def consume(n):
+    s = make_scale(n)
+    host = np.asarray(s)         # line 13: device value from another module
+    t = make_table(n)
+    total = float(t)             # line 15: cross-module .item-class sync
+    return host, total
+
+
+def consume_direct(n):
+    return np.array(make_scale(n))   # line 20: converter on foreign call

@@ -1,0 +1,14 @@
+"""Cross-module taint pair, producer half: DEVICE values leave this
+module. No findings fire here — the sync happens in taint_consumer.py,
+and only the ProjectIndex's cross-module summaries connect the two.
+Never imported — parsed only by tools.analyze in tests."""
+import jax.numpy as jnp
+
+
+def make_scale(n):
+    return jnp.full((n,), 0.5)
+
+
+def make_table(n):
+    table = jnp.arange(n)
+    return table

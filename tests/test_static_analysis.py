@@ -36,6 +36,8 @@ ALL_RULES = {
     "log-hygiene",
     "peer-json-shape",
     "unjoined-thread",
+    "hbm-budget",
+    "orphaned-async-task",
 }
 
 #: fixture file → exact expected (rule, line) findings
@@ -78,6 +80,36 @@ GOLDEN = {
     "threads_bad.py": {
         ("unjoined-thread", 7),
         ("unjoined-thread", 11),
+    },
+    "hbm_budget_bad.py": {
+        ("hbm-budget", 12),
+        ("hbm-budget", 16),
+        ("hbm-budget", 20),
+        ("hbm-budget", 25),
+        ("hbm-budget", 42),
+    },
+    "async_bad.py": {
+        ("orphaned-async-task", 7),
+        ("orphaned-async-task", 11),
+        ("orphaned-async-task", 17),
+    },
+    # the cross-module taint pair: silent when analyzed alone (neither
+    # half shows both the device producer and the sync) — the findings
+    # only exist when one ProjectIndex spans both files, asserted by
+    # test_cross_module_taint_pair below
+    "taint_producer.py": set(),
+    "taint_consumer.py": set(),
+}
+
+#: cross-module expectations: {fileset: {(rule, path, line)}}
+CROSS_MODULE = {
+    ("taint_producer.py", "taint_consumer.py"): {
+        ("no-host-sync-in-hot-path",
+         "tests/fixtures/analyze/taint_consumer.py", 13),
+        ("no-host-sync-in-hot-path",
+         "tests/fixtures/analyze/taint_consumer.py", 15),
+        ("no-host-sync-in-hot-path",
+         "tests/fixtures/analyze/taint_consumer.py", 20),
     },
 }
 
@@ -169,3 +201,180 @@ def test_cli_list_rules():
     assert out.returncode == 0
     for rule in ALL_RULES:
         assert rule in out.stdout
+
+
+# ------------------------------------------------- cross-module analysis
+
+
+def test_cross_module_taint_pair():
+    """A device value produced in one module and synced in another is
+    invisible to either file alone and CAUGHT when the ProjectIndex
+    spans both — the tentpole contract."""
+    for fileset, want in CROSS_MODULE.items():
+        paths = [FIXTURES / f for f in fileset]
+        active, _ = analyze_paths(paths, root=REPO)
+        got = {(f.rule, f.path, f.line) for f in active}
+        assert got == want, f"{fileset}: got {sorted(got)}"
+
+
+def test_cross_module_blocking_io_through_call_graph(tmp_path):
+    """lock-io resolves a call under a lock through ANOTHER module's
+    function summary (the upgrade from one-level same-module
+    resolution)."""
+    (tmp_path / "io_mod.py").write_text(
+        "import requests\n"
+        "def refresh(url):\n"
+        "    return requests.get(url, timeout=5)\n"
+    )
+    (tmp_path / "locky.py").write_text(
+        "import threading\n"
+        "from io_mod import refresh\n"
+        "_lock = threading.Lock()\n"
+        "def warm(url):\n"
+        "    with _lock:\n"
+        "        return refresh(url)\n"
+    )
+    active, _ = analyze_paths([tmp_path], root=tmp_path)
+    hits = [(f.rule, f.path, f.line) for f in active]
+    assert ("no-blocking-io-under-lock", "locky.py", 6) in hits, hits
+
+
+def test_cross_module_lock_order_cycle(tmp_path):
+    """lock-order builds edges through calls into OTHER modules."""
+    (tmp_path / "store_mod.py").write_text(
+        "import threading\n"
+        "store_lock = threading.Lock()\n"
+        "def commit():\n"
+        "    with store_lock:\n"
+        "        return True\n"
+    )
+    (tmp_path / "peer_mod.py").write_text(
+        "import threading\n"
+        "from store_mod import commit\n"
+        "peer_lock = threading.Lock()\n"
+        "def publish():\n"
+        "    with peer_lock:\n"
+        "        return commit()\n"      # peer_lock → store_lock
+    )
+    (tmp_path / "other_mod.py").write_text(
+        "import threading\n"
+        "from peer_mod import publish\n"
+        "import store_mod\n"
+        "def refresh():\n"
+        "    with store_mod.store_lock:\n"
+        "        return publish()\n"     # store_lock → peer_lock: cycle
+    )
+    active, _ = analyze_paths([tmp_path], rule_ids=["lock-order"],
+                              root=tmp_path)
+    assert any(f.rule == "lock-order" for f in active), [
+        f.render() for f in active]
+
+
+# ---------------------------------------------------- CLI modes / cache
+
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analyze", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "PYTHONPATH": str(REPO)})
+
+
+def test_result_cache_roundtrip_and_invalidation(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("def f(fetch):\n"
+                   "    try:\n"
+                   "        return fetch()\n"
+                   "    except:\n"
+                   "        return None\n")
+    cold = _run_cli(["--stats", "mod.py"], tmp_path)
+    assert cold.returncode == 1
+    assert "cache: miss" in cold.stderr
+    assert "mod.py:4 no-bare-except" in cold.stdout
+    warm = _run_cli(["--stats", "mod.py"], tmp_path)
+    assert warm.returncode == 1
+    assert "cache: hit" in warm.stderr
+    assert warm.stdout == cold.stdout  # identical findings replayed
+    # touching the file's CONTENT invalidates (mtime/size key)
+    src.write_text(src.read_text().replace("except:", "except OSError:"))
+    changed = _run_cli(["--stats", "mod.py"], tmp_path)
+    assert changed.returncode == 0
+    assert "cache: miss" in changed.stderr
+    # --no-cache neither reads nor refreshes
+    off = _run_cli(["--stats", "--no-cache", "mod.py"], tmp_path)
+    assert "cache: off" in off.stderr
+
+
+def test_warm_cache_is_subsecond():
+    """The tier-1 gate contract: a warm full-tree run finishes fast."""
+    import time
+
+    _run_cli(["demodel_tpu"], REPO)  # ensure the entry exists
+    t0 = time.perf_counter()
+    warm = _run_cli(["--stats", "demodel_tpu"], REPO)
+    secs = time.perf_counter() - t0
+    assert warm.returncode == 0, warm.stdout + warm.stderr
+    assert "cache: hit" in warm.stderr
+    assert secs < 1.0, f"warm analyze run took {secs:.2f}s"
+
+
+def test_sarif_output(tmp_path):
+    out = _run_cli(
+        ["--sarif", str(tmp_path / "out.sarif"),
+         "tests/fixtures/analyze/async_bad.py"], REPO)
+    assert out.returncode == 1
+    import json
+
+    doc = json.loads((tmp_path / "out.sarif").read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "demodel-analyze"
+    results = run["results"]
+    assert {r["ruleId"] for r in results} == {"orphaned-async-task"}
+    locs = {(r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+             r["locations"][0]["physicalLocation"]["region"]["startLine"])
+            for r in results}
+    assert ("tests/fixtures/analyze/async_bad.py", 7) in locs
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert ALL_RULES <= rule_ids
+
+
+def test_check_suppressions_requires_reason(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(
+        "def f(fetch):\n"
+        "    try:\n"
+        "        return fetch()\n"
+        "    except:  # demodel: allow(no-bare-except) — degrade contract\n"
+        "        return None\n")
+    ok = _run_cli(["--check-suppressions", "good.py"], tmp_path)
+    assert ok.returncode == 0, ok.stderr
+    bad = tmp_path / "bad.py"
+    bad.write_text(good.read_text().replace(" — degrade contract", ""))
+    fail = _run_cli(["--check-suppressions", "bad.py"], tmp_path)
+    assert fail.returncode == 1
+    assert "no justification" in fail.stderr
+
+
+def test_changed_only_scopes_reporting(tmp_path):
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True,
+                   timeout=30)
+    clean = tmp_path / "clean_mod.py"
+    clean.write_text("def f(fetch):\n"
+                     "    try:\n"
+                     "        return fetch()\n"
+                     "    except:\n"
+                     "        return None\n")
+    subprocess.run(["git", "add", "."], cwd=tmp_path, check=True, timeout=30)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-qm", "x"], cwd=tmp_path, check=True,
+                   timeout=30)
+    # committed file has a finding, but only CHANGED files are reported
+    out = _run_cli(["--changed-only", "--no-cache", "."], tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+    dirty = tmp_path / "dirty_mod.py"
+    dirty.write_text(clean.read_text())
+    out = _run_cli(["--changed-only", "--no-cache", "."], tmp_path)
+    assert out.returncode == 1
+    assert "dirty_mod.py:4" in out.stdout
+    assert "clean_mod.py" not in out.stdout

@@ -2,16 +2,90 @@
 
 Exit code 0 when the tree has no unsuppressed findings, 1 otherwise —
 what tier-1 (tests/test_static_analysis.py) and CI gate on.
+
+Modes on top of the plain run:
+
+- ``--json`` / ``--sarif PATH`` — machine-readable findings (SARIF is
+  what CI uploads so findings annotate PRs; ``-`` writes to stdout);
+- ``--changed-only`` — report only files touched per ``git status``;
+  the ProjectIndex still spans every analyzed file, so cross-module
+  findings in a changed file keep firing;
+- ``--stats`` — per-rule finding/suppression counts and files/s;
+- ``--check-suppressions`` — every inline ``# demodel: allow(rule)``
+  must carry a justification (text after the allow); violations fail
+  the run, so the suppression count cannot grow reason-free;
+- results are cached (``.demodel-analyze-cache.json``) keyed on every
+  analyzed file's (path, mtime, size) plus the analyzer's own sources —
+  ``--no-cache`` forces a cold run.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
+import time
 from pathlib import Path
 
-from tools.analyze.core import REGISTRY, analyze_paths
+from tools.analyze.core import (
+    REGISTRY,
+    SUPPRESS_RE,
+    analyze_paths,
+    iter_py_files,
+)
+
+
+def _changed_files(root: Path) -> set[str] | None:
+    """Repo-relative posix paths touched per git (staged, unstaged,
+    untracked), or None when git is unavailable."""
+    try:
+        # -uall: list files inside untracked directories individually
+        # (default -unormal collapses them to one "dir/" entry, which
+        # would silently drop every finding in a newly added package)
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--no-renames", "-uall"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    changed: set[str] = set()
+    for line in out.stdout.splitlines():
+        if len(line) > 3:
+            changed.add(line[3:].strip().strip('"'))
+    return changed
+
+
+def check_suppressions(files) -> list[str]:
+    """Inline allows lacking a justification: every
+    ``# demodel: allow(rule)`` must be followed by reason text (same
+    line after the paren, or the continuation of a comment block)."""
+    bad: list[str] = []
+    for path in files:
+        try:
+            lines = Path(path).read_text(
+                encoding="utf-8", errors="replace").splitlines()
+        except OSError:
+            continue
+        for i, line in enumerate(lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            reason = line[m.end():].strip().strip("—-–: ").strip()
+            # comment-block form: the justification may span the
+            # following comment-only lines — accumulate them all, so a
+            # short first continuation ("# why:") doesn't mask real text
+            # further down the block
+            j = i
+            while j < len(lines) and lines[j].strip().startswith("#"):
+                reason += " " + lines[j].strip().lstrip("#").strip("—-–: ")
+                j += 1
+            if len(reason.strip()) < 8:
+                bad.append(f"{path}:{i} allow({m.group(1)}) carries no "
+                           "justification — say why this pattern is "
+                           "deliberate")
+    return bad
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -27,6 +101,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="print the rule catalogue and exit")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as JSON")
+    ap.add_argument("--sarif", metavar="PATH",
+                    help="write findings as SARIF 2.1.0 to PATH ('-' = stdout)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only git-changed files (index stays "
+                         "whole-program)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not update the result cache")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-rule counts and files/s to stderr")
+    ap.add_argument("--check-suppressions", action="store_true",
+                    help="fail when an inline allow() carries no reason text")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings (marked)")
     args = ap.parse_args(argv)
@@ -43,14 +128,69 @@ def main(argv: list[str] | None = None) -> int:
     if missing:
         print(f"no such path: {', '.join(map(str, missing))}", file=sys.stderr)
         return 2
-    active, suppressed = analyze_paths(paths, rule_ids=args.rule or None)
+    root = Path.cwd()
+    files = iter_py_files(paths)
 
+    report_only: set[str] | None = None
+    if args.changed_only:
+        changed = _changed_files(root)
+        if changed is None:
+            print("warning: git unavailable; --changed-only analyzing "
+                  "everything", file=sys.stderr)
+        else:
+            rels = set()
+            for p in files:
+                try:
+                    rels.add(p.resolve().relative_to(
+                        root.resolve()).as_posix())
+                except ValueError:
+                    rels.add(p.as_posix())
+            report_only = rels & changed
+
+    t0 = time.perf_counter()
+    cache_state = "off"
+    active = suppressed = None
+    key = None
+    if not args.no_cache:
+        from tools.analyze import cache
+
+        key = cache.run_key(files, args.rule or None, report_only)
+        hit = cache.load(root, key)
+        if hit is not None:
+            active, suppressed = hit
+            cache_state = "hit"
+        else:
+            cache_state = "miss"
+    if active is None:
+        active, suppressed = analyze_paths(
+            paths, rule_ids=args.rule or None, report_only=report_only)
+        if key is not None:
+            from tools.analyze import cache
+
+            cache.store(root, key, active, suppressed)
+    secs = time.perf_counter() - t0
+
+    bad_sup: list[str] = []
+    if args.check_suppressions:
+        bad_sup = check_suppressions(files)
+        for b in bad_sup:
+            print(b, file=sys.stderr)
+
+    if args.sarif:
+        import tools.analyze.passes  # noqa: F401 — populate REGISTRY
+        from tools.analyze.sarif import to_sarif
+
+        doc = json.dumps(to_sarif(active, suppressed, REGISTRY), indent=2)
+        if args.sarif == "-":
+            print(doc)
+        else:
+            Path(args.sarif).write_text(doc + "\n")
     if args.as_json:
         print(json.dumps({
             "findings": [vars(f) for f in active],
             "suppressed": [vars(f) for f in suppressed],
         }, indent=2))
-    else:
+    elif args.sarif != "-":  # SARIF-to-stdout owns stdout
         for f in active:
             print(f.render())
         if args.show_suppressed:
@@ -58,7 +198,26 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"{f.render()}  [suppressed]")
         tail = f"{len(active)} finding(s), {len(suppressed)} suppressed"
         print(tail, file=sys.stderr)
-    return 1 if active else 0
+
+    if args.stats:
+        import tools.analyze.passes  # noqa: F401 — populate REGISTRY
+
+        per_rule: dict[str, list[int]] = {}
+        for f in active:
+            per_rule.setdefault(f.rule, [0, 0])[0] += 1
+        for f in suppressed:
+            per_rule.setdefault(f.rule, [0, 0])[1] += 1
+        print("— stats —", file=sys.stderr)
+        for rid in sorted(set(REGISTRY) | set(per_rule)):
+            a, s = per_rule.get(rid, (0, 0))
+            print(f"  {rid}: {a} finding(s), {s} suppressed",
+                  file=sys.stderr)
+        rate = len(files) / secs if secs > 0 else float("inf")
+        print(f"  files: {len(files)}  secs: {secs:.3f}  "
+              f"files/s: {rate:.0f}  cache: {cache_state}",
+              file=sys.stderr)
+
+    return 1 if (active or bad_sup) else 0
 
 
 if __name__ == "__main__":

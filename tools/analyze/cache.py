@@ -1,0 +1,101 @@
+"""Result cache: sub-second warm runs for the tier-1 analyze gate.
+
+The unit of caching is the WHOLE run, keyed by every input that can
+change its output: the (path, mtime, size) triple of every analyzed
+file, the analyzer's own sources (same triples — editing a pass
+invalidates), the rule selection, and the report filter. Any change
+recomputes everything; a hit replays the stored findings. That makes the
+cache trivially sound for interprocedural rules — a per-file cache would
+have to reason about which summaries a cross-module edit invalidates,
+and a wrong answer there silently hides findings.
+
+The store is a small JSON file at the repo root
+(``.demodel-analyze-cache.json``, gitignored), capped at a handful of
+entries (LRU) so switching between ``demodel_tpu`` and fixture runs does
+not thrash.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+
+from tools.analyze.core import Finding
+
+CACHE_NAME = ".demodel-analyze-cache.json"
+MAX_ENTRIES = 6
+_TOOL_DIR = Path(__file__).resolve().parent
+
+
+def _stat_triples(files) -> list:
+    out = []
+    for p in files:
+        try:
+            st = os.stat(p)
+        except OSError:
+            out.append((str(p), 0, -1))
+            continue
+        out.append((str(p), st.st_mtime_ns, st.st_size))
+    return out
+
+
+def run_key(files, rule_ids, report_only) -> str:
+    """Digest of everything that determines a run's findings."""
+    tool_files = sorted(_TOOL_DIR.rglob("*.py"))
+    payload = {
+        "files": _stat_triples(files),
+        "tool": _stat_triples(tool_files),
+        "rules": sorted(rule_ids) if rule_ids else None,
+        # None (no filter) and set() (filter matching nothing) are
+        # different runs with different outputs — must not share a key
+        "report_only": sorted(report_only) if report_only is not None
+        else None,
+    }
+    blob = json.dumps(payload, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _cache_path(root: Path) -> Path:
+    return Path(root) / CACHE_NAME
+
+
+def load(root: Path, key: str):
+    """``(active, suppressed)`` lists for ``key``, or None on miss."""
+    try:
+        data = json.loads(_cache_path(root).read_text())
+    except (OSError, ValueError):
+        return None
+    for entry in data.get("entries", []):
+        if entry.get("key") == key:
+            try:
+                return (
+                    [Finding(**f) for f in entry["active"]],
+                    [Finding(**f) for f in entry["suppressed"]],
+                )
+            except (KeyError, TypeError):
+                return None
+    return None
+
+
+def store(root: Path, key: str, active, suppressed) -> None:
+    path = _cache_path(root)
+    try:
+        data = json.loads(path.read_text())
+        entries = [e for e in data.get("entries", [])
+                   if e.get("key") != key]
+    except (OSError, ValueError):
+        entries = []
+    entries.append({
+        "key": key,
+        "active": [vars(f) for f in active],
+        "suppressed": [vars(f) for f in suppressed],
+    })
+    entries = entries[-MAX_ENTRIES:]
+    try:
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps({"entries": entries}))
+        tmp.replace(path)
+    except OSError:
+        pass  # a read-only checkout just runs cold every time

@@ -1,0 +1,484 @@
+"""ProjectIndex: repo-wide symbol table + call graph + effect summaries.
+
+The compositional-analysis layer (Infer/RacerD shape): one bottom-up walk
+per function collects a small *effect summary* — "returns a device value",
+"performs blocking I/O at line N", "acquires lock X", "allocates device
+bytes with placement from Y" — and a resolved call graph lets rules
+compose those summaries across module boundaries with a call-depth bound,
+instead of re-walking the whole tree per query.
+
+Resolution levels (in order):
+
+- bare names → same-module functions (including enclosing-scope nested
+  defs);
+- ``self.method()`` → the enclosing class's method;
+- imported names — ``import a.b as x`` / ``from a.b import c as d`` —
+  resolved through the per-module alias table to project definitions;
+- ``Class.method`` / ``alias_module.func`` dotted chains;
+- constructor-typed locals: ``r = PeerBlobReader(...); r.pread(...)``
+  resolves through the local's known class.
+
+Receivers typed only at runtime (``self.attr.m()``, dict-dispatched
+callables) stay unresolved — passes treat unresolved calls as effect-free,
+keeping the analysis under-approximate (no speculative edges) like the
+seed's one-level resolution was.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Iterable
+
+from tools.analyze.core import dotted, walk_in_scope
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from tools.analyze.core import ModuleContext
+
+LOCKISH_RE = re.compile(r"lock|mutex", re.IGNORECASE)
+BUDGETISH_RE = re.compile(r"budget", re.IGNORECASE)
+
+#: jax.* calls that return HOST values (device handles, counts, pytree
+#: plumbing) — consuming them on the host is not a sync
+HOST_RESULT = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.process_count", "jax.process_index",
+    "jax.default_backend", "jax.make_mesh", "jax.random.split",
+}
+HOST_RESULT_PREFIXES = ("jax.tree", "jax.sharding", "jax.dtypes", "jnp.shape")
+
+#: calls that allocate NEW device buffers (the hbm-budget rule's subjects)
+DEVICE_ALLOCATORS = {
+    "jax.device_put", "jax.make_array_from_single_device_arrays",
+}
+JNP_ALLOCATORS = {
+    "jnp.zeros", "jnp.ones", "jnp.empty", "jnp.full", "jnp.arange",
+    "jnp.array", "jnp.asarray", "jnp.linspace", "jnp.eye",
+}
+
+_BLOCKING_PREFIXES = ("requests.", "subprocess.", "socket.",
+                      "urllib.request.")
+_BLOCKING_EXACT = {"time.sleep", "open", "urlopen"}
+_BLOCKING_ATTRS = {"recv", "recvfrom", "sendall", "accept", "makefile",
+                   "read_bytes", "write_bytes", "read_text", "write_text"}
+_HTTP_VERBS = {"get", "post", "put", "patch", "delete", "head", "request"}
+
+
+def device_producer(call: ast.Call) -> bool:
+    """Does this call produce a DEVICE value (jnp./jax. minus the
+    host-result table)?"""
+    name = dotted(call.func)
+    if not name:
+        return False
+    if name in HOST_RESULT or name.startswith(HOST_RESULT_PREFIXES):
+        return False
+    return name.startswith(("jnp.", "jax."))
+
+
+def blocking_call(node: ast.Call, ctx: "ModuleContext") -> str | None:
+    """Why this call blocks (network/disk/sleep), or None."""
+    name = dotted(node.func)
+    if name:
+        if name in _BLOCKING_EXACT:
+            return f"{name}()"
+        if name.startswith(_BLOCKING_PREFIXES):
+            return f"{name}()"
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        recv = ctx.src(node.func.value)
+        if attr in _BLOCKING_ATTRS:
+            return f".{attr}() on {recv}"
+        if attr in _HTTP_VERBS and "session" in recv.lower():
+            return f"HTTP {attr}() on {recv}"
+    return None
+
+
+def lock_id(ctx: "ModuleContext", expr: ast.AST,
+            cls: ast.ClassDef | None, fn: ast.AST | None,
+            aliases: dict | None = None) -> str | None:
+    """Normalized lock identity (``module.Class.attr`` for self members,
+    ``module.func.name`` for locals), or None when not lock-shaped.
+
+    ``aliases`` (this module's import table) makes the identity stable
+    ACROSS modules: ``with store_mod.store_lock:`` and a ``with
+    store_lock:`` inside ``store_mod`` itself normalize to the same
+    node, which is what lets the lock graph see cross-module cycles."""
+    src = ctx.src(expr)
+    if not LOCKISH_RE.search(src):
+        return None
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        if expr.value.id == "self":
+            scope = cls.name if cls else "<module>"
+            return f"{ctx.module}.{scope}.{expr.attr}"
+        if aliases and expr.value.id in aliases:
+            # imported-module member: normalize to the owning module
+            return f"{aliases[expr.value.id]}.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        if aliases and expr.id in aliases:
+            # from store_mod import store_lock → store_mod.store_lock
+            return aliases[expr.id]
+        if fn is not None and any(
+            isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == expr.id
+                for t in n.targets
+            )
+            for n in ast.walk(fn)
+        ):
+            name = getattr(fn, "name", "<lambda>")
+            return f"{ctx.module}.{name}.{expr.id}"
+        return f"{ctx.module}.{expr.id}"
+    return f"{ctx.module}.{src}"
+
+
+@dataclass
+class AllocSite:
+    """One device-allocating call and where its placement comes from."""
+
+    node: ast.Call
+    line: int
+    call_name: str                 # jax.device_put / jnp.zeros / ...
+    #: "plan" (plan/NamedSharding-derived), ("param", name), or "unknown";
+    #: None for allocators with NO placement argument at all
+    placement: object = None
+
+
+@dataclass
+class FunctionInfo:
+    qname: str
+    module: str
+    rel: str
+    name: str
+    node: ast.AST
+    cls: str | None = None               # enclosing class qname, if method
+    params: list = field(default_factory=list)
+    #: resolved call sites: [(qname | None, raw dotted | None, node)]
+    calls: list = field(default_factory=list)
+    #: does a `return` directly yield a jnp./jax. produced value?
+    returns_device_direct: bool = False
+    #: callee qnames whose result this function returns (propagation edges)
+    returns_calls: set = field(default_factory=set)
+    #: first direct blocking call: (line, why) | None
+    blocking_direct: tuple | None = None
+    #: lock ids acquired anywhere in the body (with-statements)
+    acquires: set = field(default_factory=set)
+    #: device allocations performed directly in the body
+    allocs: list = field(default_factory=list)
+    #: calls `.acquire(...)` on a *budget*-named receiver (byte accounting)
+    budget_acquire: bool = False
+    #: spawns a thread / asyncio task directly
+    spawns: bool = False
+    #: names the function's body passes to an executor/Thread (escaping
+    #: callables — used by hbm-budget's concurrent-buffer clause)
+    escapes_to_worker: set = field(default_factory=set)
+
+
+class ProjectIndex:
+    """Symbol table + call graph over a set of parsed modules."""
+
+    #: summary composition bound — RacerD-style: deep enough to cross
+    #: ops/ → sink/ → delivery chains, shallow enough to stay linear
+    MAX_DEPTH = 4
+
+    def __init__(self, contexts: Iterable["ModuleContext"]):
+        self.contexts = list(contexts)
+        self.by_module: dict[str, "ModuleContext"] = {
+            c.module: c for c in self.contexts}
+        #: function qname → FunctionInfo
+        self.functions: dict[str, FunctionInfo] = {}
+        #: id(FunctionDef node) → FunctionInfo (pass-side reverse lookup)
+        self.by_node: dict[int, FunctionInfo] = {}
+        #: class qname → {method name → function qname}
+        self.classes: dict[str, dict[str, str]] = {}
+        #: module → {local alias → fully qualified target}
+        self.aliases: dict[str, dict[str, str]] = {}
+        #: rel path → {id(call node) → resolved qname} (for passes)
+        self.resolution: dict[str, dict[int, str]] = {}
+        #: rel path → {id(call node) → enclosing FunctionInfo}
+        self._owner: dict[str, dict[int, FunctionInfo]] = {}
+        self._memo_device: dict = {}
+        self._memo_block: dict = {}
+        self._memo_locks: dict = {}
+        for ctx in self.contexts:
+            self._collect_defs(ctx)
+        for ctx in self.contexts:
+            self._collect_bodies(ctx)
+
+    # ------------------------------------------------------------ build
+    def _collect_defs(self, ctx: "ModuleContext") -> None:
+        self.aliases[ctx.module] = aliases = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative import → anchor on this package
+                    pkg = ctx.module.split(".")
+                    pkg = pkg[: len(pkg) - node.level]
+                    base = ".".join(pkg + ([node.module]
+                                           if node.module else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{base}.{a.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qname, cls = self._qname_of(ctx, node)
+                info = FunctionInfo(
+                    qname=qname, module=ctx.module, rel=ctx.rel,
+                    name=node.name, node=node, cls=cls,
+                    params=[a.arg for a in (
+                        node.args.posonlyargs + node.args.args
+                        + node.args.kwonlyargs)],
+                )
+                self.functions[qname] = info
+                self.by_node[id(node)] = info
+                if cls is not None:
+                    self.classes.setdefault(cls, {})[node.name] = qname
+            elif isinstance(node, ast.ClassDef):
+                qname, _ = self._qname_of(ctx, node)
+                self.classes.setdefault(qname, {})
+
+    @staticmethod
+    def _qname_of(ctx: "ModuleContext", node: ast.AST):
+        """``module.Outer.name`` plus the nearest enclosing class qname."""
+        chain = []
+        cls: str | None = None
+        cur = getattr(node, "_dm_parent", None)
+        nearest_cls_depth = None
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                chain.append(cur.name)
+                if isinstance(cur, ast.ClassDef) and nearest_cls_depth is None:
+                    nearest_cls_depth = len(chain)
+            cur = getattr(cur, "_dm_parent", None)
+        chain.reverse()
+        if nearest_cls_depth is not None:
+            # chain was appended innermost-first, so after reverse the
+            # nearest class sits at -nearest_cls_depth
+            cls_chain = chain[: len(chain) - nearest_cls_depth + 1]
+            cls = f"{ctx.module}." + ".".join(cls_chain)
+        qual = ".".join(chain + [node.name]) if chain else node.name
+        return f"{ctx.module}.{qual}", cls
+
+    def _collect_bodies(self, ctx: "ModuleContext") -> None:
+        res = self.resolution.setdefault(ctx.rel, {})
+        own = self._owner.setdefault(ctx.rel, {})
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            info = self.functions[self._qname_of(ctx, node)[0]]
+            local_types = self._constructor_types(ctx, node)
+            dev_names: set[str] = set()
+            call_assigned: dict[str, str] = {}  # name → callee qname
+            for sub in walk_in_scope(node):
+                if isinstance(sub, ast.Call):
+                    q = self._resolve(ctx, node, sub, local_types)
+                    info.calls.append((q, dotted(sub.func), sub))
+                    if q is not None:
+                        res[id(sub)] = q
+                    own[id(sub)] = info
+                    self._note_effects(ctx, node, info, sub)
+                elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                    for item in sub.items:
+                        lid = lock_id(ctx, item.context_expr,
+                                      self._cls_node(ctx, info), node,
+                                      self.aliases.get(ctx.module))
+                        if lid is not None:
+                            info.acquires.add(lid)
+                elif isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name) \
+                        and isinstance(sub.value, ast.Call):
+                    tgt = sub.targets[0].id
+                    if device_producer(sub.value):
+                        dev_names.add(tgt)
+                    q = self._resolve(ctx, node, sub.value, local_types)
+                    if q is not None:
+                        call_assigned[tgt] = q
+            for sub in walk_in_scope(node):
+                if not isinstance(sub, ast.Return) or sub.value is None:
+                    continue
+                val = sub.value
+                if isinstance(val, ast.Call):
+                    if device_producer(val):
+                        info.returns_device_direct = True
+                    else:
+                        q = self._resolve(ctx, node, val, local_types)
+                        if q is not None:
+                            info.returns_calls.add(q)
+                elif isinstance(val, ast.Name):
+                    if val.id in dev_names:
+                        info.returns_device_direct = True
+                    elif val.id in call_assigned:
+                        info.returns_calls.add(call_assigned[val.id])
+
+    def _cls_node(self, ctx: "ModuleContext",
+                  info: FunctionInfo) -> ast.ClassDef | None:
+        from tools.analyze.core import enclosing_class
+
+        return enclosing_class(info.node)
+
+    def _constructor_types(self, ctx: "ModuleContext",
+                           fn: ast.AST) -> dict[str, str]:
+        """Locals typed by a constructor call to a known project class."""
+        out: dict[str, str] = {}
+        for sub in walk_in_scope(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call):
+                q = self._resolve_name(ctx, dotted(sub.value.func) or "")
+                if q in self.classes:
+                    out[sub.targets[0].id] = q
+        return out
+
+    def _resolve_name(self, ctx: "ModuleContext", name: str) -> str | None:
+        """Resolve a dotted name through this module's alias table."""
+        if not name:
+            return None
+        parts = name.split(".")
+        aliases = self.aliases.get(ctx.module, {})
+        if parts[0] in aliases:
+            return ".".join([aliases[parts[0]]] + parts[1:])
+        return f"{ctx.module}.{name}"
+
+    def _resolve(self, ctx: "ModuleContext", fn: ast.AST, call: ast.Call,
+                 local_types: dict[str, str]) -> str | None:
+        """Resolve a call to a project function qname, or None."""
+        name = dotted(call.func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        # self.method()
+        if parts[0] == "self" and len(parts) == 2:
+            from tools.analyze.core import enclosing_class
+
+            cls = enclosing_class(call)
+            if cls is not None:
+                cq, _ = self._qname_of(ctx, cls)
+                return self.classes.get(cq, {}).get(parts[1])
+            return None
+        # constructor-typed local receiver: r.pread()
+        if len(parts) == 2 and parts[0] in local_types:
+            return self.classes.get(local_types[parts[0]], {}).get(parts[1])
+        resolved = self._resolve_name(ctx, name)
+        if resolved in self.functions:
+            return resolved
+        # Class(...) constructor → its __init__ when indexed
+        if resolved in self.classes:
+            return self.classes[resolved].get("__init__")
+        # bare name defined in an enclosing scope (nested defs)
+        if len(parts) == 1:
+            scope_q, _ = self._qname_of(ctx, fn)
+            prefix = scope_q
+            while "." in prefix:
+                prefix = prefix.rsplit(".", 1)[0]
+                cand = f"{prefix}.{name}"
+                if cand in self.functions:
+                    return cand
+        return None
+
+    def _note_effects(self, ctx: "ModuleContext", fn: ast.AST,
+                      info: FunctionInfo, call: ast.Call) -> None:
+        name = dotted(call.func) or ""
+        if info.blocking_direct is None:
+            why = blocking_call(call, ctx)
+            if why is not None:
+                info.blocking_direct = (call.lineno, why)
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "acquire" \
+                and BUDGETISH_RE.search(ctx.src(call.func.value)):
+            info.budget_acquire = True
+        if name == "Thread" or name.endswith(".Thread") \
+                or name.endswith(("create_task", "ensure_future")):
+            info.spawns = True
+        if name in DEVICE_ALLOCATORS or name in JNP_ALLOCATORS:
+            info.allocs.append(AllocSite(
+                node=call, line=call.lineno, call_name=name))
+        # callables escaping to worker threads/executors
+        if name.endswith(".submit") and call.args:
+            tgt = call.args[0]
+            if isinstance(tgt, ast.Name):
+                info.escapes_to_worker.add(tgt.id)
+        if name == "Thread" or name.endswith(".Thread"):
+            for kw in call.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                    info.escapes_to_worker.add(kw.value.id)
+
+    # -------------------------------------------------- composed queries
+    def callers_of(self, qname: str) -> list:
+        """[(caller FunctionInfo, call node)] for every resolved call."""
+        out = []
+        for info in self.functions.values():
+            for q, _raw, node in info.calls:
+                if q == qname:
+                    out.append((info, node))
+        return out
+
+    def returns_device(self, qname: str, depth: int | None = None) -> bool:
+        """Does ``qname`` (transitively, bounded) return a device value?"""
+        depth = self.MAX_DEPTH if depth is None else depth
+        key = (qname, depth)
+        if key in self._memo_device:
+            return self._memo_device[key]
+        self._memo_device[key] = False  # cycle guard: assume host
+        info = self.functions.get(qname)
+        out = False
+        if info is not None:
+            if info.returns_device_direct:
+                out = True
+            elif depth > 0:
+                out = any(self.returns_device(q, depth - 1)
+                          for q in info.returns_calls)
+        self._memo_device[key] = out
+        return out
+
+    def blocking(self, qname: str, depth: int | None = None) -> tuple | None:
+        """``(line, why, via)`` when calling ``qname`` can block on
+        network/disk/sleep (bounded transitive), else None. ``via`` is the
+        qname whose body holds the direct blocking call."""
+        depth = self.MAX_DEPTH if depth is None else depth
+        key = (qname, depth)
+        if key in self._memo_block:
+            return self._memo_block[key]
+        self._memo_block[key] = None  # cycle guard
+        info = self.functions.get(qname)
+        out = None
+        if info is not None:
+            if info.blocking_direct is not None:
+                out = (*info.blocking_direct, qname)
+            elif depth > 0:
+                for q, _raw, node in info.calls:
+                    if q is None or q == qname:
+                        continue
+                    sub = self.blocking(q, depth - 1)
+                    if sub is not None:
+                        out = sub
+                        break
+        self._memo_block[key] = out
+        return out
+
+    def acquired_locks(self, qname: str, depth: int | None = None) -> set:
+        """Lock ids ``qname`` may acquire, bounded-transitively."""
+        depth = self.MAX_DEPTH if depth is None else depth
+        key = (qname, depth)
+        if key in self._memo_locks:
+            return self._memo_locks[key]
+        self._memo_locks[key] = set()  # cycle guard
+        info = self.functions.get(qname)
+        out: set = set()
+        if info is not None:
+            out |= info.acquires
+            if depth > 0:
+                for q, _raw, _node in info.calls:
+                    if q is not None and q != qname:
+                        out |= self.acquired_locks(q, depth - 1)
+        self._memo_locks[key] = out
+        return out
+
+    def owner_of(self, ctx_rel: str, call: ast.Call) -> FunctionInfo | None:
+        return self._owner.get(ctx_rel, {}).get(id(call))
+
+    def resolve_in(self, ctx_rel: str, call: ast.Call) -> str | None:
+        return self.resolution.get(ctx_rel, {}).get(id(call))

@@ -2,7 +2,9 @@
 pass by dropping a module here and importing it below."""
 
 from tools.analyze.passes import (  # noqa: F401 — registration imports
+    async_tasks,
     excepts,
+    hbm_budget,
     host_sync,
     jit_hygiene,
     json_shape,

@@ -1,0 +1,183 @@
+"""orphaned-async-task: ``asyncio.create_task``/``ensure_future`` results
+that nothing owns — completing the ``unjoined-thread`` family.
+
+The event loop keeps only a WEAK reference to a task: a discarded
+``create_task`` result can be garbage-collected mid-flight, and its
+exception is never retrieved ("Task exception was never retrieved" at
+interpreter shutdown, silent loss before that). Error paths are the same
+trap one level up: a task created before an ``await`` that raises is
+orphaned unless a ``finally``/handler cancels or awaits it.
+
+A created task is OWNED (no finding) when, in the same scope, it is:
+
+- awaited (``await t``), cancelled (``t.cancel()``), or gathered;
+- passed to a call (``asyncio.wait(tasks)``, ``group.append(t)``) — the
+  receiver can await it;
+- stored (attribute/subscript/collection literal/comprehension),
+  returned, or yielded.
+
+Additionally, a name-bound task whose ONLY await sits after another
+``await`` (a suspension that can raise) fires unless some enclosing
+``try``'s handler or ``finally`` references the task — the
+cancel-on-error-path discipline.
+
+Deliberate fire-and-forget gets an inline
+``# demodel: allow(orphaned-async-task)`` with a why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analyze.core import (
+    Finding,
+    ModuleContext,
+    Pass,
+    dotted,
+    enclosing_function,
+    register,
+    walk_in_scope,
+)
+
+
+def _is_task_ctor(node: ast.Call) -> bool:
+    name = dotted(node.func)
+    return name is not None and (
+        name.endswith("create_task") or name.endswith("ensure_future"))
+
+
+def _scope_of(node: ast.AST, ctx: ModuleContext) -> ast.AST:
+    fn = enclosing_function(node)
+    return fn if fn is not None else ctx.tree
+
+
+def _name_referenced(tree_part: list, name: str) -> bool:
+    for stmt in tree_part:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    return False
+
+
+def _events(scope: ast.AST, name: str) -> dict:
+    """How a task-bound name is used inside ``scope``."""
+    ev = {"owned": False, "awaited_at": None}
+    for sub in walk_in_scope(scope):
+        if isinstance(sub, ast.Await):
+            val = sub.value
+            if isinstance(val, ast.Name) and val.id == name:
+                ev["owned"] = True
+                if ev["awaited_at"] is None:
+                    ev["awaited_at"] = sub.lineno
+            # await gather(t, ...) handled by the call-arg clause below
+        if isinstance(sub, ast.Call):
+            if isinstance(sub.func, ast.Attribute) \
+                    and isinstance(sub.func.value, ast.Name) \
+                    and sub.func.value.id == name \
+                    and sub.func.attr in ("cancel", "add_done_callback",
+                                          "result", "exception"):
+                ev["owned"] = True
+            for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    ev["owned"] = True
+                if isinstance(arg, ast.Starred) \
+                        and isinstance(arg.value, ast.Name) \
+                        and arg.value.id == name:
+                    ev["owned"] = True
+        if isinstance(sub, ast.Assign):
+            for tgt in sub.targets:
+                if (isinstance(tgt, (ast.Attribute, ast.Subscript))
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == name):
+                    ev["owned"] = True
+        if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+            val = sub.value
+            if isinstance(val, ast.Name) and val.id == name:
+                ev["owned"] = True
+            if isinstance(val, (ast.Tuple, ast.List)):
+                for elt in val.elts:
+                    if isinstance(elt, ast.Name) and elt.id == name:
+                        ev["owned"] = True
+    return ev
+
+
+@register
+class OrphanedAsyncTaskPass(Pass):
+    id = "orphaned-async-task"
+    description = (
+        "asyncio.create_task/ensure_future result discarded, never "
+        "awaited/cancelled/stored, or not covered on error paths (weak-ref "
+        "GC + swallowed exceptions)"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not _is_task_ctor(node):
+                continue
+            parent = getattr(node, "_dm_parent", None)
+            # bare statement: the loop's weak ref is the ONLY ref
+            if isinstance(parent, ast.Expr):
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    "task reference discarded — the event loop holds only "
+                    "a weak ref, so the task can be GC'd mid-flight and "
+                    "its exception is never retrieved",
+                )
+                continue
+            if not (isinstance(parent, ast.Assign)
+                    and len(parent.targets) == 1
+                    and isinstance(parent.targets[0], ast.Name)):
+                # stored in a collection/arg/comprehension/attribute —
+                # ownership moved somewhere that can await it
+                continue
+            name = parent.targets[0].id
+            scope = _scope_of(node, ctx)
+            ev = _events(scope, name)
+            if not ev["owned"]:
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    f"task '{name}' is never awaited, gathered, cancelled, "
+                    "or stored — orphaned the moment this scope exits",
+                )
+                continue
+            f = self._error_path_orphan(ctx, scope, parent, name, ev)
+            if f is not None:
+                yield f
+
+    def _error_path_orphan(self, ctx: ModuleContext, scope: ast.AST,
+                           assign: ast.Assign, name: str,
+                           ev: dict) -> Finding | None:
+        """Awaited, but an intermediate ``await`` between creation and the
+        task's own await can raise with nothing cancelling the task."""
+        if ev["awaited_at"] is None:
+            return None  # owned some other way (stored/gathered/cancelled)
+        intermediate = None
+        for sub in walk_in_scope(scope):
+            if not isinstance(sub, ast.Await) or sub.lineno <= assign.lineno \
+                    or sub.lineno >= ev["awaited_at"]:
+                continue
+            if isinstance(sub.value, ast.Name) and sub.value.id == name:
+                continue
+            # an await of something else, while our task is in flight
+            intermediate = sub
+            break
+        if intermediate is None:
+            return None
+        # covered when ANY try enclosing the intermediate await references
+        # the task in a handler or finally (cancel/await/gather)
+        cur = getattr(intermediate, "_dm_parent", None)
+        while cur is not None and cur is not scope:
+            if isinstance(cur, ast.Try):
+                guards = list(cur.finalbody)
+                for h in cur.handlers:
+                    guards.extend(h.body)
+                if _name_referenced(guards, name):
+                    return None
+            cur = getattr(cur, "_dm_parent", None)
+        return Finding(
+            ctx.rel, intermediate.lineno, self.id,
+            f"awaiting here can raise while task '{name}' is in flight — "
+            f"no enclosing finally/except cancels it (created line "
+            f"{assign.lineno})",
+        )

@@ -1,0 +1,371 @@
+"""hbm-budget: device allocations on the delivery/sink planes must be
+accounted — placed through the sharding plan or charged to a ByteBudget.
+
+The sink's whole contract is that HBM and host-RAM residency are known
+quantities: every tensor lands under a ``ShardingPlan``-derived
+``NamedSharding`` (``sink/hbm.py``'s ``place_tensor`` family) and every
+landing buffer is charged to the delivery ``ByteBudget`` before the
+bytes exist (``sink/streaming.py``). An allocation that bypasses both is
+invisible to that accounting: a bare ``jax.device_put(x)`` lands the
+whole tensor replicated on the default device, and an uncharged landing
+buffer on a concurrent fetch path can pin ``workers × shard`` host RAM.
+
+Three finding classes, on sink-plane modules (``demodel_tpu/sink/``,
+``demodel_tpu/delivery.py``, or a ``# demodel: sink-plane`` pragma):
+
+1. ``jax.device_put``/``jax.make_array_from_single_device_arrays`` whose
+   placement argument is missing or not *plan-derived*. Plan-derived:
+   the result of ``.sharding_for(...)`` or ``NamedSharding(...)``, or
+   anything reached from one (``sharding.addressable_devices_indices_map``
+   → ``dev_map`` → ``for device, idx in dev_map.items():``). A placement
+   fed by a function PARAMETER is judged through the call graph: the
+   allocation is fine when some resolved caller demonstrably threads a
+   plan-derived value through it (the contract is proven — how
+   ``place_tensor``'s ``device=`` stays accounted from two modules away),
+   and the blame moves to call sites — a sink-plane call that fills such
+   a placement parameter with a value NOT derived from the plan is the
+   finding (Infer-style: report where the contract breaks, not where the
+   primitive lives). Callers outside the sink plane (e.g. the restore
+   plane, a consumer with its own exact layout) are not judged.
+2. ``jnp.*`` array constructors — the sink plane moves bytes, it does
+   not make tensors; a ``jnp.zeros`` here is an unplanned replicated
+   allocation.
+3. a host landing buffer (``np.empty``/``np.zeros``/``bytearray``)
+   allocated inside a function that ESCAPES to a worker
+   (``executor.submit(f)`` / ``Thread(target=f)``) and is filled by a
+   ``pread_into``-style ranged read, with no ``<budget>.acquire(...)``
+   in the function or its enclosing scope — concurrent landing buffers
+   outside the ByteBudget are exactly the unbounded-RAM failure mode the
+   budget exists to prevent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analyze.core import (
+    Finding,
+    ModuleContext,
+    Pass,
+    dotted,
+    enclosing_function,
+    register,
+    walk_in_scope,
+)
+from tools.analyze.index import JNP_ALLOCATORS
+
+SINK_PRAGMA = "# demodel: sink-plane"
+_SINK_PATHS = ("demodel_tpu/sink/",)
+_SINK_FILES = ("demodel_tpu/delivery.py",)
+
+_PLACED_ALLOCATORS = {"jax.device_put",
+                      "jax.make_array_from_single_device_arrays"}
+#: argument position of the placement (device/sharding) operand
+_PLACEMENT_POS = {"jax.device_put": 1,
+                  "jax.make_array_from_single_device_arrays": 1}
+_PLACEMENT_KW = {"jax.device_put": ("device",),
+                 "jax.make_array_from_single_device_arrays": ("sharding",)}
+
+_HOST_BUFFER_CTORS = {"np.empty", "np.zeros", "numpy.empty", "numpy.zeros",
+                      "bytearray"}
+_RANGED_READS = {"pread_into", "read_into", "readinto"}
+
+#: callers examined per parameter while composing placement summaries
+_MAX_DEPTH = 3
+
+
+def _is_sink_plane(ctx: ModuleContext) -> bool:
+    return (
+        any(ctx.rel.startswith(p) for p in _SINK_PATHS)
+        or ctx.rel in _SINK_FILES
+        or SINK_PRAGMA in ctx.source
+    )
+
+
+def _plan_derived_names(fn: ast.AST, seed: frozenset = frozenset()) -> set[str]:
+    """Names in ``fn``'s scope that hold plan/sharding-derived values:
+    seeded by ``.sharding_for(...)`` / ``NamedSharding(...)`` results
+    (plus ``seed`` — used to test whether a parameter feeds a placement),
+    closed over attribute/method derivation, aliasing, and tuple loop
+    targets over a derived mapping."""
+    derived: set[str] = set(seed)
+
+    def value_derived(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            name = dotted(expr.func) or ""
+            if name.endswith(".sharding_for") or name == "NamedSharding" \
+                    or name.endswith(".NamedSharding"):
+                return True
+            # method on a derived receiver: sharding.addressable_...()
+            if isinstance(expr.func, ast.Attribute) \
+                    and isinstance(expr.func.value, ast.Name) \
+                    and expr.func.value.id in derived:
+                return True
+        if isinstance(expr, ast.Name):
+            return expr.id in derived
+        if isinstance(expr, ast.Attribute):
+            return isinstance(expr.value, ast.Name) \
+                and expr.value.id in derived
+        return False
+
+    # fixed point: derivation chains (sharding → dev_map → device) can
+    # appear in any statement order
+    for _ in range(4):
+        before = len(derived)
+        for node in walk_in_scope(fn):
+            if isinstance(node, ast.Assign) and value_derived(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        derived.add(tgt.id)
+            elif isinstance(node, ast.For) and value_derived(node.iter):
+                for tgt in ast.walk(node.target):
+                    if isinstance(tgt, ast.Name):
+                        derived.add(tgt.id)
+            elif isinstance(node, ast.comprehension) \
+                    and value_derived(node.iter):
+                for tgt in ast.walk(node.target):
+                    if isinstance(tgt, ast.Name):
+                        derived.add(tgt.id)
+        if len(derived) == before:
+            break
+    return derived
+
+
+def _root_name(expr: ast.AST) -> str | None:
+    root = expr
+    while isinstance(root, (ast.Attribute, ast.Subscript)):
+        root = root.value
+    return root.id if isinstance(root, ast.Name) else None
+
+
+def _placement_expr(call: ast.Call, name: str) -> ast.AST | None:
+    pos = _PLACEMENT_POS[name]
+    if len(call.args) > pos and not any(
+            isinstance(a, ast.Starred) for a in call.args[:pos + 1]):
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg in _PLACEMENT_KW[name]:
+            return kw.value
+    return None
+
+
+@register
+class HbmBudgetPass(Pass):
+    id = "hbm-budget"
+    description = (
+        "device allocation on the delivery/sink plane that bypasses the "
+        "sharding plan and the ByteBudget (unplanned HBM / unbounded "
+        "landing RAM)"
+    )
+
+    def __init__(self) -> None:
+        super().__init__()
+        #: sink-plane contexts seen (call-site contract checks run in
+        #: finalize, once the param-placed allocator set is complete)
+        self._sink_ctxs: list = []
+        #: allocator qname → placement param name (functions whose device
+        #: allocation is placed through a parameter)
+        self._param_placed: dict[str, str] = {}
+
+    # ---------------------------------------------------------- helpers
+    def _fn_budgeted(self, fn: ast.AST | None) -> bool:
+        """Does ``fn`` (or an enclosing def) charge a ByteBudget?"""
+        while fn is not None:
+            info = self._info_for(fn)
+            if info is not None and info.budget_acquire:
+                return True
+            fn = enclosing_function(fn)
+        return False
+
+    def _locally_accounted(self, fn: ast.AST, expr: ast.AST) -> bool:
+        """Plan-derived within ``fn``'s own scope (no caller knowledge)."""
+        if isinstance(expr, ast.Call):
+            name = dotted(expr.func) or ""
+            if name.endswith(".sharding_for") or name == "NamedSharding" \
+                    or name.endswith(".NamedSharding"):
+                return True
+        root = _root_name(expr)
+        return root is not None and root in _plan_derived_names(fn)
+
+    def _placement_param(self, fn: ast.AST, expr: ast.AST) -> str | None:
+        """The parameter of ``fn`` that feeds this placement expr
+        (possibly through locals: sharding → dev_map → device)."""
+        root = _root_name(expr)
+        info = self._info_for(fn)
+        if root is None or info is None:
+            return None
+        for p in info.params:
+            if p != "self" and root in _plan_derived_names(
+                    fn, frozenset({p})):
+                return p
+        return None
+
+    def _info_for(self, fn: ast.AST):
+        if self.index is None:
+            return None
+        return self.index.by_node.get(id(fn))
+
+    def _arg_for(self, info, call: ast.Call, param: str) -> ast.AST | None:
+        try:
+            pos = info.params.index(param)
+        except ValueError:
+            return None
+        if info.cls is not None and info.params and info.params[0] == "self":
+            pos -= 1  # call sites don't pass self
+        if len(call.args) > pos and not any(
+                isinstance(a, ast.Starred) for a in call.args[:pos + 1]):
+            return call.args[pos]
+        for kw in call.keywords:
+            if kw.arg == param:
+                return kw.value
+        return None
+
+    def _site_accounted(self, fn: ast.AST, expr: ast.AST,
+                        depth: int) -> bool:
+        """Accounted at this site: locally plan-derived, or fed by a
+        parameter that SOME resolved caller fills with an accounted value
+        (bounded composition — proves the plan is threaded through)."""
+        if self._locally_accounted(fn, expr):
+            return True
+        param = self._placement_param(fn, expr)
+        info = self._info_for(fn)
+        if param is None or info is None or depth <= 0:
+            return False
+        for caller, call in self.index.callers_of(info.qname):
+            arg = self._arg_for(info, call, param)
+            if arg is not None and self._site_accounted(
+                    caller.node, arg, depth - 1):
+                return True
+        return False
+
+    # ------------------------------------------------------------ visit
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _is_sink_plane(ctx):
+            return
+        self._sink_ctxs.append(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name in _PLACED_ALLOCATORS:
+                fn = enclosing_function(node) or ctx.tree
+                if self._fn_budgeted(enclosing_function(node)):
+                    continue
+                expr = _placement_expr(node, name)
+                if expr is None:
+                    yield Finding(
+                        ctx.rel, node.lineno, self.id,
+                        f"{name}(...) with no device/sharding operand lands "
+                        "the whole tensor replicated on the default device, "
+                        "outside the sharding plan",
+                    )
+                    continue
+                param = self._placement_param(fn, expr) \
+                    if not self._locally_accounted(fn, expr) else None
+                if param is not None:
+                    info = self._info_for(fn)
+                    if info is not None:
+                        # call sites are judged in finalize; the
+                        # allocation itself is fine once some caller
+                        # proves the plan threads through
+                        self._param_placed.setdefault(info.qname, param)
+                if not self._site_accounted(fn, expr, _MAX_DEPTH):
+                    yield Finding(
+                        ctx.rel, node.lineno, self.id,
+                        f"{name}(...) placement is not derived from the "
+                        "sharding plan (plan.sharding_for / NamedSharding) "
+                        "— these device bytes bypass delivery accounting",
+                    )
+            elif name in JNP_ALLOCATORS:
+                if self._fn_budgeted(enclosing_function(node)):
+                    continue
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    f"{name}(...) materializes an unplanned device array on "
+                    "the sink plane — route tensors through the plan "
+                    "(place_tensor) or move this off the delivery path",
+                )
+        yield from self._check_worker_buffers(ctx)
+
+    def finalize(self) -> Iterator[Finding]:
+        """Call-site contract checks: a sink-plane call that fills a
+        param-placed allocator's placement parameter with a value not
+        derived from the plan is where the accounting breaks."""
+        if self.index is None or not self._param_placed:
+            return
+        for ctx in self._sink_ctxs:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                q = self.index.resolve_in(ctx.rel, node)
+                if q is None or q not in self._param_placed:
+                    continue
+                callee = self.index.functions[q]
+                param = self._param_placed[q]
+                owner = self.index.owner_of(ctx.rel, node)
+                fn = owner.node if owner is not None else ctx.tree
+                if self._fn_budgeted(owner.node if owner else None):
+                    continue
+                arg = self._arg_for(callee, node, param)
+                if arg is None:
+                    continue
+                if not self._site_accounted(fn, arg, _MAX_DEPTH):
+                    yield Finding(
+                        ctx.rel, node.lineno, self.id,
+                        f"{q.rsplit('.', 1)[-1]}() places device bytes "
+                        f"through its {param!r} parameter, but this call "
+                        "fills it with a value not derived from the "
+                        "sharding plan (plan.sharding_for / NamedSharding)",
+                    )
+
+    def _check_worker_buffers(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self.index is None:
+            return
+        escaped: set[str] = set()
+        for info in self.index.functions.values():
+            if info.rel == ctx.rel:
+                escaped |= info.escapes_to_worker
+        if not escaped:
+            return
+        for info in self.index.functions.values():
+            if info.rel != ctx.rel or info.name not in escaped:
+                continue
+            if self._fn_budgeted(info.node):
+                continue
+            # two sweeps: walk_in_scope order is not source order, so
+            # collect the buffer names first, then look for ranged reads
+            buffers: dict[str, int] = {}
+            for sub in walk_in_scope(info.node):
+                if isinstance(sub, ast.Assign) \
+                        and isinstance(sub.value, ast.Call) \
+                        and (dotted(sub.value.func) or "") \
+                        in _HOST_BUFFER_CTORS:
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            buffers[tgt.id] = sub.value.lineno
+            fed = False
+            for sub in walk_in_scope(info.node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in _RANGED_READS:
+                    for arg in list(sub.args) + [k.value for k in
+                                                 sub.keywords]:
+                        root = arg
+                        while isinstance(root, (ast.Attribute,
+                                                ast.Subscript, ast.Call)):
+                            root = getattr(root, "value",
+                                           getattr(root, "func", None))
+                            if root is None:
+                                break
+                        if isinstance(root, ast.Name) and root.id in buffers:
+                            fed = True
+            if buffers and fed:
+                line = min(buffers.values())
+                yield Finding(
+                    ctx.rel, line, self.id,
+                    f"landing buffer in {info.name}() runs on a worker "
+                    "(submitted to an executor/thread) without "
+                    "ByteBudget.acquire — concurrent fetch buffers outside "
+                    "the budget can pin workers × shard bytes of host RAM",
+                )

@@ -2,11 +2,17 @@
 delivery hot path (demodel_tpu/{ops,sink,parallel}).
 
 ``.block_until_ready()``, plus ``np.asarray``/``np.array``/``float``/
-``int``/``bool``/``.item()``/``.tolist()`` applied to values produced by
-``jnp.*``/``jax.*`` calls in the same function. Each of these forces the
-host to wait on the device stream — inside the streamed-delivery window
-that serializes fetch, dispatch, and transfer and silently caps
-throughput.
+``int``/``bool``/``.item()``/``.tolist()`` applied to device values. Each
+of these forces the host to wait on the device stream — inside the
+streamed-delivery window that serializes fetch, dispatch, and transfer
+and silently caps throughput.
+
+Device values are tracked **interprocedurally** through the
+ProjectIndex: a name assigned from a call whose resolved callee
+(bounded-depth summary composition, any module) returns a device value is
+tainted the same as a direct ``jnp.*``/``jax.*`` producer — so a tensor
+built in ``ops/`` and synced in ``sink/`` is visible even though neither
+module alone shows both halves.
 """
 
 from __future__ import annotations
@@ -22,43 +28,11 @@ from tools.analyze.core import (
     register,
     walk_in_scope,
 )
-
-#: jax.* calls that return HOST values (device handles, counts, pytree
-#: plumbing) — their results are not device arrays, so consuming them on
-#: the host is not a sync
-_HOST_RESULT = {
-    "jax.devices", "jax.local_devices", "jax.device_count",
-    "jax.local_device_count", "jax.process_count", "jax.process_index",
-    "jax.default_backend", "jax.make_mesh", "jax.random.split",
-}
-_HOST_RESULT_PREFIXES = ("jax.tree", "jax.sharding", "jax.dtypes")
+from tools.analyze.index import device_producer
 
 _CONVERTERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
                "float", "int", "bool"}
 _SYNC_METHODS = {"item", "tolist"}
-
-
-def _device_producer(call: ast.Call) -> bool:
-    name = dotted(call.func)
-    if not name:
-        return False
-    if name in _HOST_RESULT or name.startswith(_HOST_RESULT_PREFIXES):
-        return False
-    return name.startswith(("jnp.", "jax."))
-
-
-def _tainted_names(fn: ast.AST) -> set[str]:
-    """Names assigned from a jnp./jax. call in ``fn``'s own scope (nested
-    defs are separate scopes analyzed on their own — a closure's device
-    locals must not taint same-named host values outside it)."""
-    out: set[str] = set()
-    for node in walk_in_scope(fn):
-        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
-                and _device_producer(node.value):
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name):
-                    out.add(tgt.id)
-    return out
 
 
 @register
@@ -66,8 +40,34 @@ class HostSyncPass(Pass):
     id = "no-host-sync-in-hot-path"
     description = (
         "device→host sync (.block_until_ready / np.asarray / float / .item "
-        "on device values) inside demodel_tpu/{ops,sink,parallel}"
+        "on device values, incl. values returned across module boundaries) "
+        "inside demodel_tpu/{ops,sink,parallel}"
     )
+
+    def _device_call(self, ctx: ModuleContext, call: ast.Call) -> bool:
+        """Direct jnp./jax. producer, or a resolved project callee whose
+        bounded summary says it returns a device value."""
+        if device_producer(call):
+            return True
+        if self.index is not None:
+            q = self.index.resolve_in(ctx.rel, call)
+            if q is not None and self.index.returns_device(q):
+                return True
+        return False
+
+    def _tainted_names(self, ctx: ModuleContext, fn: ast.AST) -> set[str]:
+        """Names assigned from a device-producing call in ``fn``'s own
+        scope (nested defs are separate scopes analyzed on their own — a
+        closure's device locals must not taint same-named host values
+        outside it)."""
+        out: set[str] = set()
+        for node in walk_in_scope(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and self._device_call(ctx, node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+        return out
 
     def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
         if not ctx.hot:
@@ -78,7 +78,8 @@ class HostSyncPass(Pass):
         ]
         seen: set[int] = set()
         for scope in scopes:
-            tainted = _tainted_names(scope) if scope is not ctx.tree else set()
+            tainted = self._tainted_names(ctx, scope) \
+                if scope is not ctx.tree else set()
             for node in walk_in_scope(scope):
                 if not isinstance(node, ast.Call) or id(node) in seen:
                     continue
@@ -111,17 +112,24 @@ class HostSyncPass(Pass):
                 f"{node.func.value.id!r} copies to host and blocks on the "
                 "device stream",
             )
-        # host converters applied to a device value
+        # host converters applied to a device value (assigned locally, OR
+        # returned straight out of a resolved cross-module callee)
         if name in _CONVERTERS and node.args:
             arg = node.args[0]
             arg_is_device = (
                 (isinstance(arg, ast.Name) and arg.id in tainted)
-                or (isinstance(arg, ast.Call) and _device_producer(arg))
+                or (isinstance(arg, ast.Call) and self._device_call(ctx, arg))
             )
             if arg_is_device:
+                why = ""
+                if isinstance(arg, ast.Call) and not device_producer(arg):
+                    q = self.index.resolve_in(ctx.rel, arg) \
+                        if self.index else None
+                    if q is not None:
+                        why = f" (device value returned by {q})"
                 return Finding(
                     ctx.rel, node.lineno, self.id,
                     f"{name}(...) on a device value materializes it on host "
-                    "(hidden device sync + copy)",
+                    f"(hidden device sync + copy){why}",
                 )
         return None

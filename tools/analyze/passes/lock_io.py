@@ -1,12 +1,12 @@
-"""no-blocking-io-under-lock: flag blocking I/O lexically inside a
-``with <lock>:`` body.
+"""no-blocking-io-under-lock: flag blocking I/O inside a ``with <lock>:``
+body.
 
 A node-wide lock held across a network round-trip or disk write turns one
 slow peer into a convoy: every thread needing the lock (store commits,
 index refreshes, metric scrapes) queues behind the I/O. Direct calls are
-flagged, plus one level of intra-module resolution — a call under the
-lock to a same-module function / same-class method that itself performs
-blocking I/O.
+flagged, plus calls under the lock that the ProjectIndex resolves — any
+module, bounded call depth — to a function whose effect summary says it
+performs blocking I/O.
 
 Single-flight patterns (a dedicated per-key lock serializing exactly the
 I/O it guards, like ``PeerSet.index``) are legitimate; annotate them with
@@ -23,77 +23,28 @@ from tools.analyze.core import (
     Finding,
     ModuleContext,
     Pass,
-    dotted,
-    enclosing_class,
     register,
     walk_in_scope,
 )
+from tools.analyze.index import blocking_call
 
 _LOCKISH_RE = re.compile(r"lock|mutex", re.IGNORECASE)
-
-_BLOCKING_PREFIXES = ("requests.", "subprocess.", "socket.",
-                      "urllib.request.")
-_BLOCKING_EXACT = {"time.sleep", "open", "urlopen"}
-#: method names that block regardless of receiver
-_BLOCKING_ATTRS = {"recv", "recvfrom", "sendall", "accept", "makefile",
-                   "read_bytes", "write_bytes", "read_text", "write_text"}
-#: HTTP verbs — blocking when the receiver looks like an HTTP session
-_HTTP_VERBS = {"get", "post", "put", "patch", "delete", "head", "request"}
 
 
 def _is_lock_ctx(src: str) -> bool:
     return bool(_LOCKISH_RE.search(src))
 
 
-def _blocking_call(node: ast.Call, ctx: ModuleContext) -> str | None:
-    """Why this call blocks, or None."""
-    name = dotted(node.func)
-    if name:
-        if name in _BLOCKING_EXACT:
-            return f"{name}()"
-        if name.startswith(_BLOCKING_PREFIXES):
-            return f"{name}()"
-    if isinstance(node.func, ast.Attribute):
-        attr = node.func.attr
-        recv = ctx.src(node.func.value)
-        if attr in _BLOCKING_ATTRS:
-            return f".{attr}() on {recv}"
-        if attr in _HTTP_VERBS and "session" in recv.lower():
-            return f"HTTP {attr}() on {recv}"
-    return None
-
-
-def _local_blocking_callables(ctx: ModuleContext) -> dict[str, int]:
-    """``name`` / ``Class.name`` → line of the blocking call inside it, for
-    every function/method in this module that directly performs blocking
-    I/O (one level of propagation, no recursion)."""
-    out: dict[str, int] = {}
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        # scope-limited walk: I/O inside a nested def (a worker closure)
-        # does not run when THIS function is called under a lock
-        for sub in walk_in_scope(node):
-            if isinstance(sub, ast.Call):
-                why = _blocking_call(sub, ctx)
-                if why is not None:
-                    cls = enclosing_class(node)
-                    key = f"{cls.name}.{node.name}" if cls else node.name
-                    out.setdefault(key, sub.lineno)
-                    break
-    return out
-
-
 @register
 class LockIoPass(Pass):
     id = "no-blocking-io-under-lock"
     description = (
-        "network/disk/sleep calls lexically inside a `with <lock>:` body "
-        "(store/peer/delivery convoy hazard)"
+        "network/disk/sleep calls inside a `with <lock>:` body, directly "
+        "or through the project call graph (store/peer/delivery convoy "
+        "hazard)"
     )
 
     def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
-        blocking_fns = _local_blocking_callables(ctx)
         for node in ast.walk(ctx.tree):
             if not isinstance(node, (ast.With, ast.AsyncWith)):
                 continue
@@ -104,31 +55,23 @@ class LockIoPass(Pass):
             for sub in walk_in_scope(node):
                 if not isinstance(sub, ast.Call):
                     continue
-                why = _blocking_call(sub, ctx)
+                why = blocking_call(sub, ctx)
                 if why is not None:
                     yield Finding(
                         ctx.rel, sub.lineno, self.id,
                         f"blocking {why} while holding {lock_desc}",
                     )
                     continue
-                callee = self._resolve_local(sub, ctx)
-                if callee is not None and callee in blocking_fns:
+                callee = self.index.resolve_in(ctx.rel, sub) \
+                    if self.index is not None else None
+                if callee is None:
+                    continue
+                hit = self.index.blocking(callee)
+                if hit is not None:
+                    line, io_why, via = hit
+                    through = "" if via == callee else f" via {via}"
                     yield Finding(
                         ctx.rel, sub.lineno, self.id,
-                        f"call to {callee}() (which performs blocking I/O, "
-                        f"see line {blocking_fns[callee]}) while holding "
-                        f"{lock_desc}",
+                        f"call to {callee}(){through} (blocking {io_why} at "
+                        f"line {line}) while holding {lock_desc}",
                     )
-
-    @staticmethod
-    def _resolve_local(node: ast.Call, ctx: ModuleContext) -> str | None:
-        """Map a call to a same-module function / same-class method key."""
-        if isinstance(node.func, ast.Name):
-            return node.func.id
-        if (isinstance(node.func, ast.Attribute)
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == "self"):
-            cls = enclosing_class(node)
-            if cls is not None:
-                return f"{cls.name}.{node.func.attr}"
-        return None

@@ -1,13 +1,13 @@
-"""lock-order: build the module-level lock graph and flag cycles.
+"""lock-order: build the project-wide lock graph and flag cycles.
 
 Nodes are normalized lock identities (``module.Class.attr`` for
 ``self._lock``-style members, ``module.func.name`` for locals). Edges:
 
 - **lexical** — ``with B:`` nested inside ``with A:`` in one function
   (A held while B is acquired);
-- **one-level interprocedural** — under ``with A:``, a call to a
-  same-module function / same-class method that acquires any lock B
-  anywhere in its body.
+- **interprocedural** — under ``with A:``, a call the ProjectIndex
+  resolves (any module, bounded call depth) to a function whose summary
+  acquires any lock B.
 
 Any cycle in that graph is a potential deadlock between the store, the
 peer plane, and the restore control plane — exactly the kind TSan only
@@ -18,7 +18,6 @@ a non-reentrant ``threading.Lock``) are cycles of length 1.
 from __future__ import annotations
 
 import ast
-import re
 from typing import Iterator
 
 from tools.analyze.core import (
@@ -30,110 +29,61 @@ from tools.analyze.core import (
     register,
     walk_in_scope,
 )
-
-_LOCKISH_RE = re.compile(r"lock|mutex", re.IGNORECASE)
-
-
-def _lock_id(ctx: ModuleContext, expr: ast.AST) -> str | None:
-    """Normalized lock identity, or None when the context expr is not
-    lock-shaped."""
-    src = ctx.src(expr)
-    if not _LOCKISH_RE.search(src):
-        return None
-    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
-            and expr.value.id == "self":
-        cls = enclosing_class(expr)
-        scope = cls.name if cls else "<module>"
-        return f"{ctx.module}.{scope}.{expr.attr}"
-    if isinstance(expr, ast.Name):
-        fn = enclosing_function(expr)
-        if fn is not None and any(
-            isinstance(n, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == expr.id
-                for t in n.targets
-            )
-            for n in ast.walk(fn)
-        ):
-            # function-local lock (e.g. a per-key single-flight lock)
-            return f"{ctx.module}.{fn.name}.{expr.id}"
-        return f"{ctx.module}.{expr.id}"
-    return f"{ctx.module}.{src}"
-
-
-class _ModuleFacts:
-    def __init__(self) -> None:
-        #: callable key ("Class.name" or "name") → locks acquired anywhere
-        self.acquires: dict[str, set[str]] = {}
-        #: lock → set of (lock, rel, line) edges
-        self.edges: dict[str, set[tuple[str, str, int]]] = {}
-        #: (holding lock, callable key, rel, line) — resolved in finalize
-        self.calls_under: list[tuple[str, str, str, int]] = []
+from tools.analyze.index import lock_id
 
 
 @register
 class LockOrderPass(Pass):
     id = "lock-order"
     description = (
-        "cycles in the module-level lock acquisition graph "
+        "cycles in the project-wide lock acquisition graph "
         "(potential deadlocks across store/peer/restore)"
     )
 
     def __init__(self) -> None:
-        self._facts: list[_ModuleFacts] = []
+        super().__init__()
+        #: lock → set of (lock, rel, line) edges
+        self._edges: dict[str, set[tuple[str, str, int]]] = {}
 
     def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
-        facts = _ModuleFacts()
-        self._facts.append(facts)
         for node in ast.walk(ctx.tree):
             if not isinstance(node, (ast.With, ast.AsyncWith)):
                 continue
+            fn = enclosing_function(node)
+            cls = enclosing_class(node)
+            aliases = self.index.aliases.get(ctx.module) \
+                if self.index is not None else None
             held = [
                 lid for item in node.items
-                if (lid := _lock_id(ctx, item.context_expr)) is not None
+                if (lid := lock_id(ctx, item.context_expr, cls, fn,
+                                   aliases))
+                is not None
             ]
             if not held:
                 continue
-            fn = enclosing_function(node)
-            if fn is not None:
-                cls = enclosing_class(fn)
-                key = f"{cls.name}.{fn.name}" if cls else fn.name
-                facts.acquires.setdefault(key, set()).update(held)
             for sub in walk_in_scope(node):
                 if isinstance(sub, (ast.With, ast.AsyncWith)):
+                    sfn = enclosing_function(sub)
+                    scls = enclosing_class(sub)
                     for item in sub.items:
-                        inner = _lock_id(ctx, item.context_expr)
+                        inner = lock_id(ctx, item.context_expr, scls, sfn,
+                                        aliases)
                         if inner is not None:
                             for h in held:
-                                facts.edges.setdefault(h, set()).add(
+                                self._edges.setdefault(h, set()).add(
                                     (inner, ctx.rel, sub.lineno))
-                elif isinstance(sub, ast.Call):
-                    callee = self._callee_key(sub)
-                    if callee is not None:
+                elif isinstance(sub, ast.Call) and self.index is not None:
+                    callee = self.index.resolve_in(ctx.rel, sub)
+                    if callee is None:
+                        continue
+                    for b in self.index.acquired_locks(callee):
                         for h in held:
-                            facts.calls_under.append(
-                                (h, callee, ctx.rel, sub.lineno))
+                            self._edges.setdefault(h, set()).add(
+                                (b, ctx.rel, sub.lineno))
         return iter(())
 
-    @staticmethod
-    def _callee_key(node: ast.Call) -> str | None:
-        if isinstance(node.func, ast.Name):
-            return node.func.id
-        if (isinstance(node.func, ast.Attribute)
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == "self"):
-            cls = enclosing_class(node)
-            if cls is not None:
-                return f"{cls.name}.{node.func.attr}"
-        return None
-
     def finalize(self) -> Iterator[Finding]:
-        edges: dict[str, set[tuple[str, str, int]]] = {}
-        for facts in self._facts:
-            for a, outs in facts.edges.items():
-                edges.setdefault(a, set()).update(outs)
-            for held, callee, rel, line in facts.calls_under:
-                for b in facts.acquires.get(callee, ()):
-                    edges.setdefault(held, set()).add((b, rel, line))
+        edges = self._edges
         # cycle detection over the lock graph
         graph = {a: {b for b, _, _ in outs} for a, outs in edges.items()}
         site = {(a, b): (rel, line)

@@ -1,0 +1,68 @@
+"""SARIF 2.1.0 writer — the interchange format CI uses to annotate PRs
+(``github/codeql-action/upload-sarif`` renders each result as an inline
+review comment at its file:line)."""
+
+from __future__ import annotations
+
+SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+          "Schemas/sarif-schema-2.1.0.json")
+
+
+def to_sarif(active, suppressed, registry) -> dict:
+    """One SARIF run over both finding sets; suppressed findings carry a
+    ``suppressions`` entry so viewers show them struck-through instead of
+    hiding that they exist."""
+    rule_ids = sorted({f.rule for f in active}
+                      | {f.rule for f in suppressed}
+                      | set(registry))
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {
+                "text": getattr(registry.get(rid), "description", "") or rid,
+            },
+        }
+        for rid in rule_ids
+    ]
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+
+    def result(f, suppressed_flag: bool) -> dict:
+        out = {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": f.line},
+                },
+            }],
+        }
+        if suppressed_flag:
+            out["suppressions"] = [{
+                "kind": "inSource",
+                "justification": "inline `# demodel: allow(...)`",
+            }]
+        return out
+
+    return {
+        "$schema": SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "demodel-analyze",
+                    "informationUri":
+                        "https://example.invalid/tools/analyze",
+                    "rules": rules,
+                },
+            },
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": [result(f, False) for f in active]
+            + [result(f, True) for f in suppressed],
+        }],
+    }

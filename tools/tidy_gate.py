@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""clang-tidy baseline gate for the native data plane.
+
+``make -C native analyze`` runs this instead of raw clang-tidy: findings
+are normalized to ``(file, check)`` counts and compared against the
+checked-in baseline (``native/tidy_baseline.json``). Any NEW finding —
+a (file, check) pair absent from the baseline, or a count above its
+baselined value — fails the gate, so the native tree can only get
+cleaner. Shrinking counts are reported (run ``--update`` to ratchet the
+baseline down).
+
+Usage (cwd = native/):
+    python3 ../tools/tidy_gate.py store.cc proxy.cc selftest.cc
+    python3 ../tools/tidy_gate.py --update store.cc proxy.cc selftest.cc
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import shutil
+import subprocess
+import sys
+from collections import Counter
+from pathlib import Path
+
+FINDING_RE = re.compile(
+    r"^(?P<path>[^:\s][^:]*):(?P<line>\d+):(?P<col>\d+): "
+    r"(?:warning|error): (?P<msg>.*?) \[(?P<checks>[^\]]+)\]$")
+
+BASELINE = Path("tidy_baseline.json")
+
+
+def run_tidy(sources: list[str], extra_cc_flags: list[str]) -> str:
+    cmd = ["clang-tidy", "--quiet", *sources, "--",
+           "-std=c++17", "-x", "c++", "-I.", *extra_cc_flags]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    # clang-tidy exits non-zero when WarningsAsErrors fire; the gate's
+    # own baseline comparison decides pass/fail, so only a hard launch
+    # failure (no output at all, rc != 0) is fatal here
+    if proc.returncode != 0 and not proc.stdout.strip():
+        print(proc.stderr, file=sys.stderr)
+        raise SystemExit(f"clang-tidy failed to run (rc={proc.returncode})")
+    return proc.stdout
+
+
+def count_findings(output: str) -> Counter:
+    counts: Counter = Counter()
+    for line in output.splitlines():
+        m = FINDING_RE.match(line.strip())
+        if not m:
+            continue
+        fname = Path(m.group("path")).name
+        for check in m.group("checks").split(","):
+            counts[f"{fname}:{check.strip()}"] += 1
+    return counts
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("sources", nargs="+")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from this run's findings")
+    ap.add_argument("--cc-flag", action="append", default=[],
+                    help="extra compiler flag after -- (repeatable)")
+    args = ap.parse_args()
+
+    if shutil.which("clang-tidy") is None:
+        raise SystemExit("clang-tidy not found on PATH")
+
+    counts = count_findings(run_tidy(args.sources, args.cc_flag))
+
+    if args.update:
+        BASELINE.write_text(json.dumps(dict(sorted(counts.items())),
+                                       indent=2) + "\n")
+        print(f"baseline updated: {sum(counts.values())} finding(s) across "
+              f"{len(counts)} (file, check) pairs")
+        return 0
+
+    try:
+        baseline = Counter(json.loads(BASELINE.read_text()))
+    except FileNotFoundError:
+        baseline = Counter()
+
+    new = {k: c - baseline.get(k, 0) for k, c in counts.items()
+           if c > baseline.get(k, 0)}
+    gone = {k: baseline[k] - counts.get(k, 0) for k in baseline
+            if counts.get(k, 0) < baseline[k]}
+    for k, c in sorted(new.items()):
+        print(f"NEW: {k} (+{c})")
+    for k, c in sorted(gone.items()):
+        print(f"fixed vs baseline: {k} (-{c}) — consider --update to ratchet")
+    total = sum(counts.values())
+    print(f"clang-tidy: {total} finding(s), baseline "
+          f"{sum(baseline.values())}, new {sum(new.values())}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
