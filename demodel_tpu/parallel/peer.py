@@ -24,6 +24,12 @@ import requests
 
 from demodel_tpu.store import Store
 from demodel_tpu.utils.env import env_int
+from demodel_tpu.utils.faults import (
+    DigestMismatch,
+    PeerHealth,
+    RetryPolicy,
+    request_with_retry,
+)
 from demodel_tpu.utils.logging import get_logger
 
 log = get_logger("peer")
@@ -57,9 +63,16 @@ class PeerSet:
     """A set of peer proxy base URLs (e.g. ``http://host-a:8080``)."""
 
     def __init__(self, peers: list[str], timeout: float = 30.0,
-                 index_ttl: float = 5.0):
+                 index_ttl: float = 5.0,
+                 health: PeerHealth | None = None,
+                 policy: RetryPolicy | None = None):
         self.peers = [p.rstrip("/") for p in peers]
         self.timeout = timeout
+        #: shared wire-robustness state: breakers are process-wide, so a
+        #: peer the sharded pull found dead is skipped here too (and vice
+        #: versa) — the whole point of PeerHealth being a registry
+        self._health = health if health is not None else PeerHealth.shared()
+        self._policy = policy if policy is not None else RetryPolicy()
         #: floor between forced index refreshes — a pull with many misses
         #: must not re-download every peer's full index once per artifact
         self.index_ttl = index_ttl
@@ -102,8 +115,10 @@ class PeerSet:
                 # single-flight lock guarding exactly this download (a
                 # cold-cache fetch fan-out must not stampede /peer/index);
                 # the instance-wide self._lock is never held across it
-                r = self.session.get(f"{peer}/peer/index", timeout=self.timeout)
-                r.raise_for_status()
+                r = request_with_retry(
+                    self.session, "GET", f"{peer}/peer/index",
+                    policy=self._policy, health=self._health, peer=peer,
+                    timeout=self.timeout, what=f"peer index {peer}")
                 body = r.json()
                 # shape-validate: a peer answering 200 with junk (captive
                 # portal, wrong service on the port) must degrade to an
@@ -120,9 +135,14 @@ class PeerSet:
             return keys
 
     def locate(self, key: str) -> str | None:
-        """First peer advertising ``key`` (index refreshed on miss)."""
+        """First breaker-admitted peer advertising ``key`` (index
+        refreshed on miss). Open-breaker peers are skipped until their
+        half-open probe succeeds — a dead friend must not cost every
+        lookup a connect timeout; the upstream fallback covers the gap."""
         for refresh in (False, True):
             for peer in self.peers:
+                if not self._health.admissible(peer):
+                    continue  # read-only: index() may serve from cache
                 if key in self.index(peer, refresh=refresh):
                     return peer
         return None
@@ -133,6 +153,8 @@ class PeerSet:
         URL vs the canonical resolve URL of the same blob)."""
         for refresh in (False, True):
             for peer in self.peers:
+                if not self._health.admissible(peer):
+                    continue
                 for k, sha in self.index(peer, refresh=refresh).items():
                     if sha == digest:
                         return peer, k
@@ -162,9 +184,10 @@ class PeerSet:
         if peer is None:
             return False
         try:
-            meta = self.session.get(f"{peer}/peer/meta/{remote_key}",
-                                    timeout=self.timeout)
-            meta.raise_for_status()
+            meta = request_with_retry(
+                self.session, "GET", f"{peer}/peer/meta/{remote_key}",
+                policy=self._policy, health=self._health, peer=peer,
+                timeout=self.timeout, what=f"peer meta {remote_key}")
             peer_meta = meta.json()
             if not isinstance(peer_meta, dict):
                 raise IOError(f"peer meta for {remote_key} is not an object")
@@ -174,29 +197,8 @@ class PeerSet:
                                   remote_key=remote_key):
                 return True
 
-            partial = store.partial_size(key)
-            headers = {}
-            if partial > 0:
-                headers["Range"] = f"bytes={partial}-"
-            r = self.session.get(f"{peer}/peer/object/{remote_key}",
-                                 headers=headers,
-                                 stream=True, timeout=max(self.timeout, 300))
-            resumed = partial > 0 and r.status_code == 206
-            r.raise_for_status()
-            w = store.begin(key, resume=resumed)
-            try:
-                for chunk in r.iter_content(1 << 20):
-                    if chunk:
-                        w.append(chunk)
-                digest = w.digest()
-                if want and digest != want:
-                    w.abort(keep_partial=False)
-                    raise IOError(f"peer digest mismatch for {key}: {digest} != {want}")
-                w.commit(peer_meta)
-            except BaseException:
-                if w._open:  # noqa: SLF001
-                    w.abort(keep_partial=True)
-                raise
+            self._stream_object_into(store, peer, key, remote_key, want,
+                                     peer_meta)
             return True
         except (requests.RequestException, OSError,
                 ValueError, TypeError) as e:
@@ -206,6 +208,55 @@ class PeerSet:
             # the whole pull (peer-json-shape)
             log.warning("peer fetch of %s from %s failed: %s", key, peer, e)
             return False
+
+    def _stream_object_into(self, store: Store, peer: str, key: str,
+                            remote_key: str, want: str | None,
+                            peer_meta: dict) -> None:
+        """Stream one object into the store under the retry policy: a
+        transfer that dies mid-body keeps its partial and the next attempt
+        resumes it with a Range request — chunk-level recovery, not a
+        restart. Digest mismatches drop the partial and never retry
+        (re-reading poisoned bytes cannot converge); the caller's degrade
+        contract falls over to upstream instead."""
+
+        def one_attempt() -> None:
+            partial = store.partial_size(key)
+            headers = {}
+            if partial > 0:
+                headers["Range"] = f"bytes={partial}-"
+            r = self.session.get(f"{peer}/peer/object/{remote_key}",
+                                 headers=headers, stream=True,
+                                 timeout=max(self.timeout, 300))
+            try:
+                resumed = partial > 0 and r.status_code == 206
+                r.raise_for_status()
+                w = store.begin(key, resume=resumed)
+                try:
+                    for chunk in r.iter_content(1 << 20):
+                        if chunk:
+                            w.append(chunk)
+                    digest = w.digest()
+                    if want and digest != want:
+                        w.abort(keep_partial=False)
+                        raise DigestMismatch(
+                            f"peer digest mismatch for {key}: "
+                            f"{digest} != {want}")
+                    w.commit(peer_meta)
+                except BaseException:
+                    if w._open:  # noqa: SLF001
+                        w.abort(keep_partial=True)
+                    raise
+            finally:
+                # a failed attempt must not strand a half-consumed
+                # keep-alive connection: the serving peer's bounded pool
+                # holds a worker per connection, and the retry's own
+                # resume would queue behind the one it abandoned
+                r.close()
+
+        self._policy.call(
+            one_attempt, peer=peer, health=self._health,
+            what=f"peer object {remote_key} from {peer} "
+                 "(each retry resumes the kept partial)")
 
     def fetch_to_memory(self, key: str, expected_digest: str | None = None,
                         eager_verify: bool = True, budget=None):
@@ -239,9 +290,10 @@ class PeerSet:
         if m is None:
             return None  # https/odd peers use the store path
         try:
-            r = self.session.get(f"{peer}/peer/meta/{remote_key}",
-                                 timeout=self.timeout)
-            r.raise_for_status()
+            r = request_with_retry(
+                self.session, "GET", f"{peer}/peer/meta/{remote_key}",
+                policy=self._policy, health=self._health, peer=peer,
+                timeout=self.timeout, what=f"peer meta {remote_key}")
             peer_meta = r.json()
             # same shape-validation contract as fetch_into: junk meta from
             # a peer degrades to "no peer copy", never a crashed delivery

@@ -22,11 +22,19 @@ import requests
 
 from demodel_tpu.store import Store, key_for_uri
 from demodel_tpu.utils.env import env_int
+from demodel_tpu.utils.faults import RetryPolicy, request_with_retry
 from demodel_tpu.utils.logging import get_logger
 
 log = get_logger("registry")
 
 CHUNK = 1 << 20
+
+
+def _registry_timeout() -> int:
+    """Per-request timeout for upstream-registry metadata calls
+    (``DEMODEL_REGISTRY_TIMEOUT``, seconds). Retries ride the wire
+    :class:`RetryPolicy` on top of this."""
+    return env_int("DEMODEL_REGISTRY_TIMEOUT", 60, minimum=1)
 
 
 @dataclass
@@ -99,6 +107,10 @@ class Fetcher:
         self.buffer_budget = buffer_budget
         self._proxies = dict(proxies or {})
         self._headers = dict(headers or {})
+        #: one wire policy per Fetcher (constructed per pull, so env
+        #: overrides land); upstream registries get retries but NO
+        #: breakers — there is exactly one of each, nothing to rotate to
+        self._policy = RetryPolicy()
         self._tls = threading.local()
         self._commit_lock = threading.Lock()
         self._commit_pool: ThreadPoolExecutor | None = None
@@ -127,8 +139,10 @@ class Fetcher:
         return s
 
     def get_json(self, url: str) -> dict:
-        r = self.session.get(url, timeout=60, verify=self.verify)
-        r.raise_for_status()
+        r = request_with_retry(
+            self.session, "GET", url, policy=self._policy,
+            timeout=_registry_timeout(), verify=self.verify,
+            what=f"registry GET {url}")
         return r.json()
 
     @staticmethod
@@ -302,8 +316,11 @@ class Fetcher:
         ``/resolve`` of an LFS file). One cheap round-trip that enables
         content-address dedup before any bytes move."""
         try:
-            r = self.session.head(url, timeout=30, allow_redirects=False,
-                                  verify=self.verify)
+            r = request_with_retry(
+                self.session, "HEAD", url, policy=self._policy,
+                timeout=min(30, _registry_timeout()), allow_redirects=False,
+                verify=self.verify, check_status=False,
+                what="LFS digest probe")
         except requests.RequestException:
             return None
         etag = (r.headers.get("X-Linked-Etag") or "").strip('"')
@@ -329,8 +346,11 @@ class Fetcher:
             return None
         session_auth = "Authorization" in self.session.headers
         try:
-            h = self.session.head(url, timeout=30, allow_redirects=True,
-                                  verify=self.verify)
+            h = request_with_retry(
+                self.session, "HEAD", url, policy=self._policy,
+                timeout=min(30, _registry_timeout()), allow_redirects=True,
+                verify=self.verify, check_status=False,
+                what="upstream size probe")
         except requests.RequestException:
             return None
         size = int(h.headers.get("Content-Length") or 0)
@@ -400,8 +420,25 @@ class Fetcher:
         - partial present → resumed with a Range request (falls back to a
           full restart when the server ignores the range);
         - ``expected_digest`` (hex sha256) verified against the streamed
-          bytes; mismatch removes the entry and raises.
+          bytes; mismatch removes the entry and raises;
+        - transport failures (resets, timeouts, 429/5xx, truncation)
+          retry under the wire :class:`RetryPolicy`, each attempt resuming
+          from the kept partial — digest mismatches and other 4xx never
+          retry.
         """
+        return self._policy.call(
+            lambda: self._fetch_once(url, name, expected_digest,
+                                     media_type, extra_headers),
+            what=f"fetch {name} (each retry resumes the kept partial)")
+
+    def _fetch_once(
+        self,
+        url: str,
+        name: str,
+        expected_digest: str | None = None,
+        media_type: str = "",
+        extra_headers: dict | None = None,
+    ) -> FileArtifact:
         key = key_for_uri(url)
         t0 = time.perf_counter()
         from_peer = False

@@ -22,6 +22,11 @@ from demodel_tpu.parallel.mesh import make_mesh
 from demodel_tpu.utils.env import env_int
 from demodel_tpu.sink.hbm import Placement, place_tensor
 from demodel_tpu.sink.plan import ShardingPlan
+from demodel_tpu.utils.faults import (
+    PeerHealth,
+    RetryPolicy,
+    request_with_retry,
+)
 from demodel_tpu.utils.logging import get_logger
 
 log = get_logger("restore.client")
@@ -52,8 +57,13 @@ def restore(
     endpoint = endpoint.rstrip("/")
     t0 = time.perf_counter()
 
-    r = s.get(f"{endpoint}/restore/{model}/manifest", timeout=timeout)
-    r.raise_for_status()
+    # manifest + tensor windows ride the shared wire-robustness layer:
+    # retries with backoff here, window-level resume/failover inside
+    # PeerBlobReader below
+    r = request_with_retry(
+        s, "GET", f"{endpoint}/restore/{model}/manifest",
+        policy=RetryPolicy(), health=PeerHealth.shared(), peer=endpoint,
+        timeout=timeout, what=f"restore manifest {model}")
     manifest = r.json()
 
     out = RestoreResult(mesh_desc=f"{dict(mesh.shape)}", manifest=manifest)

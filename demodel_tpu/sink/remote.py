@@ -39,6 +39,17 @@ from demodel_tpu.delivery import manifest_key
 from demodel_tpu.sink.hbm import Placement, is_weight_file, merge_placement
 from demodel_tpu.sink.plan import ShardingPlan
 from demodel_tpu.utils.env import env_int
+from demodel_tpu.utils.faults import (
+    PeerHealth,
+    RangeIgnored,
+    RetryPolicy,
+    TruncatedBody,
+    WireError,
+    count_retry,
+    peer_cannot_serve,
+    request_with_retry,
+    retryable,
+)
 from demodel_tpu.utils.logging import get_logger
 
 log = get_logger("sink.remote")
@@ -47,6 +58,17 @@ log = get_logger("sink.remote")
 #: windows fan out over native range streams (connection setup ~free vs
 #: the transfer beyond this size)
 _NATIVE_MIN_BYTES = 4 << 20
+
+
+class WindowAbort(IOError):
+    """A window transfer died mid-body. ``got`` bytes already landed in
+    the caller's buffer (real network bytes, never re-fetched); ``cause``
+    carries the transport error for retry classification."""
+
+    def __init__(self, got: int, cause: BaseException):
+        super().__init__(str(cause))
+        self.got = got
+        self.cause = cause
 
 
 class PeerBlobReader:
@@ -59,20 +81,29 @@ class PeerBlobReader:
     GGUF dispatch) runs unchanged over the wire. Thread-safe; counts
     ``bytes_fetched`` for the pod-delivery proof ("each host reads < the
     whole checkpoint").
+
+    Window-level recovery: a failed Range read resumes at the exact
+    received offset — first on the next healthy ``failover`` peer holding
+    the same key (breaker-gated via the shared :class:`PeerHealth`), with
+    backoff when no alternative exists — so one RST at shard 14/15 costs
+    one re-issued window remainder, not the pipeline.
     """
 
     def __init__(self, peer: str, remote_key: str, size: int,
                  session: requests.Session | None = None,
-                 streams: int | None = None, timeout: float = 120.0,
-                 path: str | None = None):
-        self.peer = peer.rstrip("/")
+                 streams: int | None = None, timeout: float | None = None,
+                 path: str | None = None,
+                 failover: list[str] | None = None,
+                 health: PeerHealth | None = None,
+                 policy: RetryPolicy | None = None):
         self.remote_key = remote_key
         #: served resource path — /peer/object/{key} by default; the
         #: restore client points this at /restore/{model}/tensor/{name}
         #: (same Range semantics on the native plane)
         self.path = path or f"/peer/object/{remote_key}"
         self._size = int(size)
-        self.timeout = timeout
+        self.timeout = timeout if timeout is not None else float(
+            env_int("DEMODEL_PEER_TIMEOUT", 120, minimum=1))
         from demodel_tpu.parallel.peer import _peer_streams
 
         self.streams = streams if streams is not None else _peer_streams()
@@ -80,13 +111,60 @@ class PeerBlobReader:
         self._session = session
         self.bytes_fetched = 0
         self._count_lock = threading.Lock()
+        first = peer.rstrip("/")
+        self._peers = [first] + [q for q in
+                                 (p.rstrip("/") for p in (failover or []))
+                                 if q != first]
+        self._health = health if health is not None else PeerHealth.shared()
+        self._policy = policy if policy is not None else RetryPolicy()
+        #: guards peer/_native_host/_native_port against torn reads —
+        #: concurrent pread_into calls share this reader and one thread's
+        #: failover must not hand another thread host A with port B
+        self._peer_lock = threading.Lock()
+        self._set_peer(first)
+
+    def _set_peer(self, peer: str) -> None:
         import re as _re
 
         m = _re.match(r"^http://(\[[0-9a-fA-F:]+\]|[^:/]+)(?::(\d+))?$",
-                      self.peer)
-        # https/odd peers: every read takes the requests path
-        self._native_host = m.group(1).strip("[]") if m else None
-        self._native_port = int(m.group(2) or 80) if m else 0
+                      peer)
+        with self._peer_lock:
+            self.peer = peer
+            # https/odd peers: every read takes the requests path
+            self._native_host = m.group(1).strip("[]") if m else None
+            self._native_port = int(m.group(2) or 80) if m else 0
+
+    def _snapshot(self) -> tuple[str, str | None, int]:
+        """A consistent (peer, native_host, native_port) for one attempt."""
+        with self._peer_lock:
+            return self.peer, self._native_host, self._native_port
+
+    def _fail_over(self, from_peer: str,
+                   exclude: set | frozenset = frozenset()) -> bool:
+        """Rotate to the next breaker-admitted peer holding this key
+        (skipping ``exclude`` — peers proven unable to serve this
+        object). Returns True when the caller's source changed (it skips
+        the backoff sleep — a healthy alternative needs no cooldown). If
+        a concurrent window already rotated away from ``from_peer``,
+        that counts: the caller retries against the new source."""
+        with self._peer_lock:
+            current = self.peer
+        if current != from_peer and current not in exclude:
+            return True
+        if len(self._peers) > 1:
+            i = self._peers.index(current)
+            for step in range(1, len(self._peers)):
+                cand = self._peers[(i + step) % len(self._peers)]
+                if cand != from_peer and cand not in exclude \
+                        and self._health.allow(cand):
+                    self._set_peer(cand)
+                    return True
+        return False
+
+    def _add_fetched(self, n: int) -> None:
+        if n:
+            with self._count_lock:
+                self.bytes_fetched += n
 
     # -- Store duck-type ------------------------------------------------
     def size(self, key: str) -> int:  # noqa: ARG002 — single-object reader
@@ -105,23 +183,89 @@ class PeerBlobReader:
         if offset < 0 or offset + length > self._size:
             raise IOError(f"window [{offset}, {offset + length}) outside "
                           f"object of {self._size} bytes")
-        if self._native_host and length >= _NATIVE_MIN_BYTES:
-            n = self._window_native(view, offset, length)
-        else:
-            n = self._window_requests(view, offset, length)
-        with self._count_lock:
-            self.bytes_fetched += n
-        return n
+        got = 0
+        attempt = 0
+        start = self._policy.clock()
+        cannot_serve: set = set()  # peers that 404'd/range-refused THIS key
+        while True:
+            peer, native_host, native_port = self._snapshot()
+            try:
+                while got < length:
+                    remaining = length - got
+                    sub = view[got:]
+                    if native_host and remaining >= _NATIVE_MIN_BYTES:
+                        n = self._window_native(sub, offset + got, remaining,
+                                                peer, native_host,
+                                                native_port)
+                    else:
+                        n = self._window_requests(sub, offset + got,
+                                                  remaining, peer)
+                    self._add_fetched(n)
+                    got += n
+            except WindowAbort as e:
+                # e.got bytes are already in the buffer AND already moved
+                # over the wire — count them, keep them, never re-fetch
+                self._add_fetched(e.got)
+                got += e.got
+                if retryable(e.cause):
+                    # wire-shaped failure: health event + backoff budget
+                    self._health.record_failure(peer)
+                    attempt += 1
+                    delay = self._policy.should_retry(attempt, start,
+                                                      e.cause)
+                    if delay is None:
+                        raise IOError(
+                            f"window [{offset}, +{length}) of "
+                            f"{self.remote_key} failed at +{got} after "
+                            f"{attempt} attempt(s): {e.cause}") from e.cause
+                    count_retry(peer)
+                    switched = self._fail_over(peer, exclude=cannot_serve)
+                    log.warning(
+                        "window [%d, +%d) of %s died at +%d on %s (%s); "
+                        "resuming at the exact offset via %s "
+                        "(attempt %d/%d)",
+                        offset, length, self.remote_key, got, peer,
+                        e.cause, self._snapshot()[0], attempt + 1,
+                        self._policy.max_attempts)
+                    if not switched:
+                        self._policy.sleep(delay)
+                elif peer_cannot_serve(e.cause):
+                    # content-shaped refusal (missing blob, range-blind
+                    # peer): NOT a health event and a same-peer retry is
+                    # a deterministic re-failure — rotate once per such
+                    # peer, give up when no untried peer remains. The
+                    # rotation deliberately includes partially-warm peers
+                    cannot_serve.add(peer)
+                    if (self._policy.deadline_left(start) <= 0
+                            or not self._fail_over(peer,
+                                                   exclude=cannot_serve)):
+                        raise IOError(
+                            f"window [{offset}, +{length}) of "
+                            f"{self.remote_key}: no peer in the rotation "
+                            f"can serve it ({e.cause})") from e.cause
+                    log.warning(
+                        "peer %s cannot serve %s (%s); failing the window "
+                        "over to %s", peer, self.remote_key, e.cause,
+                        self._snapshot()[0])
+                else:
+                    raise IOError(
+                        f"window [{offset}, +{length}) of "
+                        f"{self.remote_key} failed at +{got}: "
+                        f"{e.cause}") from e.cause
+            else:
+                self._health.record_success(peer)
+                return length
 
     # -- transports -----------------------------------------------------
-    def _window_native(self, view: memoryview, offset: int,
-                       length: int) -> int:
+    def _window_native(self, view: memoryview, offset: int, length: int,
+                       peer: str, native_host: str,
+                       native_port: int) -> int:
         from demodel_tpu import native
 
         arr = np.frombuffer(view, dtype=np.uint8)
         errbuf = ctypes.create_string_buffer(512)
         n = native.lib().dm_peer_fetch_window(
-            self._native_host.encode(), self._native_port,
+            native_host.encode(), native_port,
             self.path.encode(),
             offset, length, self._size, self.streams,
             arr.ctypes.data_as(ctypes.c_void_p), errbuf, 512)
@@ -129,55 +273,95 @@ class PeerBlobReader:
             log.warning("native window fetch [%d,+%d) of %s failed (%s); "
                         "using requests", offset, length, self.remote_key,
                         errbuf.value.decode(errors="replace"))
-            return self._window_requests(view, offset, length)
+            return self._window_requests(view, offset, length, peer)
         return int(n)
 
     def _window_requests(self, view: memoryview, offset: int,
-                         length: int) -> int:
+                         length: int, peer: str) -> int:
+        """One Range attempt against ``peer`` (an explicit snapshot — a
+        concurrent failover must not swap the target mid-attempt). Bytes
+        land in ``view`` as they arrive; any failure raises
+        :class:`WindowAbort` carrying how many did, so the recovery loop
+        in :meth:`pread_into` resumes — not restarts — the window."""
         s = getattr(self._tls, "session", None) or self._session
         if s is None:
             s = self._tls.session = requests.Session()
-        r = s.get(f"{self.peer}{self.path}",
-                  headers={"Range": f"bytes={offset}-{offset + length - 1}"},
-                  stream=True, timeout=self.timeout)
-        r.raise_for_status()
-        if r.status_code != 206 and not (r.status_code == 200 and offset == 0
-                                         and length == self._size):
-            raise IOError(f"peer ignored Range (status {r.status_code}) "
-                          f"for {self.remote_key}")
         got = 0
-        for chunk in r.iter_content(1 << 20):
-            if not chunk:
-                continue
-            take = min(len(chunk), length - got)
-            view[got:got + take] = chunk[:take]
-            got += take
-            if got >= length:
-                break
+        try:
+            r = s.get(f"{peer}{self.path}",
+                      headers={"Range":
+                               f"bytes={offset}-{offset + length - 1}"},
+                      stream=True, timeout=self.timeout)
+            try:
+                r.raise_for_status()
+                if r.status_code != 206 and not (
+                        r.status_code == 200 and offset == 0
+                        and length == self._size):
+                    raise RangeIgnored(
+                        f"peer ignored Range (status {r.status_code}) "
+                        f"for {self.remote_key}")
+                for chunk in r.iter_content(1 << 20):
+                    if not chunk:
+                        continue
+                    take = min(len(chunk), length - got)
+                    view[got:got + take] = chunk[:take]
+                    got += take
+                    if got >= length:
+                        break
+            finally:
+                r.close()
+        except (requests.RequestException, WireError, OSError) as e:
+            raise WindowAbort(got, e) from e
         if got != length:
-            raise IOError(f"short peer window read: {got} != {length}")
+            raise WindowAbort(got, TruncatedBody(
+                f"short peer window read: {got} != {length} "
+                f"for {self.remote_key}"))
         return got
 
 
 def fetch_manifest(peers: list[str], model: str, source: str = "hf",
-                   timeout: float = 30.0) -> tuple[str, dict]:
+                   timeout: float = 30.0,
+                   health: PeerHealth | None = None,
+                   policy: RetryPolicy | None = None) -> tuple[str, dict]:
     """Locate and fetch the model-manifest record on a warm peer. Returns
     ``(peer_base_url, manifest_dict)``. The record is what the pull path
     persisted (`delivery._persist_manifest`), so ``files`` carries names,
     store keys, sizes, and digests — everything needed to place the model
-    without any upstream registry round-trip."""
+    without any upstream registry round-trip.
+
+    Breaker-aware: peers whose circuit breaker is open are skipped until
+    their half-open probe succeeds (a dead peer must not cost discovery a
+    full connect timeout); each attempted peer rides the retry policy."""
     mkey = manifest_key(source, model)
+    health = health if health is not None else PeerHealth.shared()
+    policy = policy if policy is not None else RetryPolicy()
     s = requests.Session()
     last_err: Exception | None = None
-    for peer in peers:
-        peer = peer.rstrip("/")
+    candidates = [p.rstrip("/") for p in peers]
+    # read-only admission filter (burns no probe slots); the claiming
+    # allow() happens right before each dial below
+    admitted = [p for p in candidates if health.admissible(p)]
+    if len(admitted) < len(candidates):
+        log.info("manifest discovery skipping %d breaker-open peer(s)",
+                 len(candidates) - len(admitted))
+    last_resort = not admitted
+    if last_resort:
+        # every breaker refuses: a last-resort sweep beats turning a
+        # brown-out into an outage
+        admitted = candidates
+    for peer in admitted:
+        if not last_resort and not health.allow(peer):
+            continue  # raced shut, or another caller owns the probe
         try:
-            r = s.get(f"{peer}/peer/object/{mkey}", timeout=timeout)
+            r = request_with_retry(
+                s, "GET", f"{peer}/peer/object/{mkey}",
+                policy=policy, health=health, peer=peer,
+                ok_statuses=(404,), timeout=timeout,
+                what=f"manifest {source}/{model} from {peer}")
             if r.status_code == 404:
                 continue
-            r.raise_for_status()
             return peer, r.json()
-        except (requests.RequestException, ValueError) as e:
+        except (requests.RequestException, OSError, ValueError) as e:
             last_err = e
             log.warning("peer %s manifest for %s failed: %s", peer, model, e)
     raise IOError(f"no peer holds a manifest for {source}/{model}"
@@ -187,10 +371,17 @@ def fetch_manifest(peers: list[str], model: str, source: str = "hf",
 def _peer_alive(peer: str, timeout: float = 3.0) -> bool:
     """Short-deadline liveness probe (``/healthz`` on the native proxy).
     Only gates which peers join the striping rotation — the manifest
-    peer is already proven by the manifest fetch itself."""
+    peer is already proven by the manifest fetch itself. Single attempt
+    (a retry would defeat the short deadline); the outcome feeds the
+    shared breaker registry."""
     try:
-        return requests.get(f"{peer}/healthz", timeout=timeout).ok
-    except requests.RequestException:
+        request_with_retry(
+            requests, "GET", f"{peer}/healthz",
+            policy=RetryPolicy(max_attempts=1, deadline=timeout),
+            health=PeerHealth.shared(), peer=peer.rstrip("/"),
+            timeout=timeout, what=f"liveness {peer}")
+        return True
+    except (requests.RequestException, OSError):
         return False
 
 
@@ -211,6 +402,16 @@ def _alive_peers(peers: list, timeout: float = 3.0) -> list:
         return []
     import asyncio
 
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        pass  # no loop in this thread — the asyncio path below owns one
+    else:
+        # asyncio.run would raise "cannot be called from a running event
+        # loop": a serving node's async handler pulling a model lands
+        # exactly here — probe on a thread pool instead
+        return _alive_peers_threaded(peers, timeout)
+
     async def _probe_all() -> list:
         tasks = {
             p: asyncio.create_task(asyncio.to_thread(_peer_alive, p, timeout))
@@ -230,22 +431,50 @@ def _alive_peers(peers: list, timeout: float = 3.0) -> list:
     return asyncio.run(_probe_all())
 
 
+def _alive_peers_threaded(peers: list, timeout: float = 3.0) -> list:
+    """`_alive_peers` for callers whose thread already runs an event loop:
+    same shape — concurrent probes, one shared deadline — on a thread
+    pool. Stragglers past the deadline are treated dead; their probe
+    threads run on to the socket timeout and exit on their own
+    (``shutdown(wait=False)`` — joining them here would hold the caller
+    for the full socket timeout, the exact stall this function exists to
+    avoid; worst case is ~2×timeout of background lingering, same bound
+    as the asyncio path's loop-shutdown join)."""
+    from concurrent.futures import ThreadPoolExecutor, wait
+
+    ex = ThreadPoolExecutor(max_workers=min(32, len(peers)),
+                            thread_name_prefix="peer-probe")
+    try:
+        futs = {p: ex.submit(_peer_alive, p, timeout) for p in peers}
+        done, _pending = wait(set(futs.values()), timeout=timeout + 0.5)
+        return [p for p, f in futs.items()
+                if f in done and not f.cancelled()
+                and f.exception() is None and f.result()]
+    finally:
+        ex.shutdown(wait=False, cancel_futures=True)
+
+
 def _reader_and_index(f: dict, peer_order: list[str], streams):
     """Open ``f`` on the first peer that can serve its safetensors index
-    (header reads fail over; window reads during delivery are handled by
-    the caller's retry policy)."""
+    (header reads fail over peer-by-peer here; window reads during
+    delivery recover inside the reader — resume-at-offset plus failover
+    to the rest of the rotation)."""
     from demodel_tpu.formats import safetensors as st
 
     last_err: Exception | None = None
-    for source_peer in peer_order:
-        reader = PeerBlobReader(source_peer, f["key"], int(f["size"]),
-                                streams=streams)
+    for i, source_peer in enumerate(peer_order):
+        reader = PeerBlobReader(
+            source_peer, f["key"], int(f["size"]), streams=streams,
+            failover=peer_order[i + 1:] + peer_order[:i])
         try:
             index = st.read_index_from(
                 lambda off, ln: reader.pread(f["key"], ln, off),
                 total_size=reader.size(f["key"]))
             return reader, index
-        except OSError as e:
+        except (OSError, ValueError) as e:
+            # ValueError: a corrupted/truncated safetensors header parses
+            # as junk — same failover as a transport error, the next peer
+            # holds a good copy
             last_err = e
             log.warning("index of %s from %s failed (%s); trying next "
                         "peer", f["name"], source_peer, e)
@@ -539,13 +768,19 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
                     for f in weight_files)):
         try:
             jobs = []
+            health = PeerHealth.shared()
             for i, f in enumerate(weight_files):
                 # stripe files round-robin across peers so a multi-peer
                 # pod spreads the DCN load; a peer missing the blob just
-                # falls over to the next in the rotated order
+                # falls over to the next in the rotated order. Peers whose
+                # breaker opened mid-pull drop out of the rotation HERE —
+                # a peer that died at file 3 must not greet files 4..N
+                # with a full read-timeout each (it re-enters via its
+                # half-open probe once the cooldown elapses)
                 rotated = peer_order[i % len(peer_order):] + \
                     peer_order[:i % len(peer_order)]
-                reader, index = _reader_and_index(f, rotated, streams)
+                reader, index = _reader_and_index(
+                    f, health.healthy(rotated), streams)
                 readers.append(reader)
                 file_tensors[f["key"]] = set(index.tensors)
                 for tname, spec in index.tensors.items():
@@ -594,9 +829,11 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
                 continue
             placed = None
             last_err: Exception | None = None
-            for source_peer in peer_order:
-                reader = PeerBlobReader(source_peer, key, size,
-                                        streams=streams)
+            retry_order = PeerHealth.shared().healthy(peer_order)
+            for pi, source_peer in enumerate(retry_order):
+                reader = PeerBlobReader(
+                    source_peer, key, size, streams=streams,
+                    failover=retry_order[pi + 1:] + retry_order[:pi])
                 try:
                     if name.endswith(".safetensors"):
                         # skip ONLY the resume survivors — skipping the
@@ -611,7 +848,9 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
                                               plan=plan)
                     readers.append(reader)
                     break
-                except OSError as e:  # incl. IOError + requests exceptions
+                except (OSError, ValueError) as e:
+                    # OSError: transport (incl. requests exceptions mapped
+                    # by the reader); ValueError: corrupt header bytes
                     last_err = e
                     readers.append(reader)  # count wasted bytes honestly
                     log.warning("delivery of %s from %s failed (%s); "
@@ -645,12 +884,16 @@ def materialize_aux_files(manifest: dict, peer: str, dest,
     dest = Path(dest)
     dest.mkdir(parents=True, exist_ok=True)
     s = requests.Session()
+    health = PeerHealth.shared()
+    policy = RetryPolicy()
     out = []
     for f in manifest.get("files", []):
         if is_weight_file(f["name"], f.get("media_type", "")):
             continue
-        r = s.get(f"{peer}/peer/object/{f['key']}", timeout=timeout)
-        r.raise_for_status()
+        r = request_with_retry(
+            s, "GET", f"{peer}/peer/object/{f['key']}",
+            policy=policy, health=health, peer=peer.rstrip("/"),
+            timeout=timeout, what=f"aux file {f['name']}")
         p = dest / f["name"].replace("/", "_")
         p.write_bytes(r.content)
         out.append(p)

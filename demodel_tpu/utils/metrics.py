@@ -15,30 +15,59 @@ from typing import Any
 
 
 class Hub:
-    """Thread-safe named counters (monotonic)."""
+    """Thread-safe named counters (monotonic) and gauges (point-in-time).
+
+    Names may carry a Prometheus label suffix built by :func:`labeled`
+    (``peer_retries_total{peer="http://a:8080"}``) — the exposition
+    groups samples under one ``# TYPE`` line per base name.
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
 
     def inc(self, name: str, amount: float = 1) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + amount
 
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
     def get(self, name: str) -> float:
         with self._lock:
             return self._counters.get(name, 0)
+
+    def get_gauge(self, name: str) -> float:
+        with self._lock:
+            return self._gauges.get(name, 0)
 
     def snapshot(self) -> dict[str, float]:
         with self._lock:
             return dict(self._counters)
 
+    def gauges(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
     def reset(self) -> None:  # tests only
         with self._lock:
             self._counters.clear()
+            self._gauges.clear()
 
 
 HUB = Hub()
+
+
+def labeled(name: str, **labels: str | None) -> str:
+    """``name{key="value",…}`` — the exposition-format sample name for a
+    labeled metric (values escaped per Prometheus text format)."""
+    inner = ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", r"\\").replace('"', r"\"")
+                     .replace("\n", r"\n"))
+        for k, v in sorted(labels.items()) if v is not None)
+    return f"{name}{{{inner}}}" if inner else name
 
 #: native proxy metrics that are point-in-time pool state, not monotonic
 #: counters — the session executor's live occupancy and queue depth
@@ -49,15 +78,25 @@ def _fmt(value: float) -> str:
     return str(int(value)) if float(value).is_integer() else repr(value)
 
 
+def _emit(lines: list[str], items: dict[str, float], mtype: str) -> None:
+    """Samples sorted by name, one ``# TYPE`` line per base metric name
+    (labeled samples of one metric sort adjacent and share it)."""
+    last_base = None
+    for name, value in sorted(items.items()):
+        base = name.split("{", 1)[0]
+        if base != last_base:
+            lines.append(f"# TYPE demodel_{base} {mtype}")
+            last_base = base
+        lines.append(f"demodel_{name} {_fmt(value)}")
+
+
 def render(proxy: Any = None, store: Any = None) -> str:
-    """Prometheus text exposition (0.0.4): HUB counters as
+    """Prometheus text exposition (0.0.4): HUB counters/gauges as
     ``demodel_<name>``, native proxy counters as ``demodel_proxy_<name>``,
     store gauges as ``demodel_store_{objects,bytes}``."""
     lines: list[str] = []
-    for name, value in sorted(HUB.snapshot().items()):
-        metric = f"demodel_{name}"
-        lines.append(f"# TYPE {metric} counter")
-        lines.append(f"{metric} {_fmt(value)}")
+    _emit(lines, HUB.snapshot(), "counter")
+    _emit(lines, HUB.gauges(), "gauge")
     if proxy is not None:
         try:
             native = proxy.metrics()

@@ -1,0 +1,33 @@
+"""Golden fixture: wire-call-policy.
+
+Direct requests-module verb calls are single-attempt and breaker-blind;
+the wire plane must route through demodel_tpu.utils.faults. The pragma
+below opts this file in (it lives outside demodel_tpu/).
+"""
+# demodel: wire-plane
+import requests
+import requests as rq
+from requests import get as rget
+from requests import head
+
+
+def manifest(url):
+    return requests.get(url, timeout=30)
+
+
+def publish(url, body):
+    return rq.post(url, data=body, timeout=30)
+
+
+def probe(url):
+    return rget(url, timeout=3)
+
+
+def exists(url):
+    return head(url, timeout=3)
+
+
+def fine(session, url):
+    # session-level calls are the faults layer's own mechanism — not
+    # flagged here (request_with_retry drives them)
+    return session.get(url, timeout=3)

@@ -1,0 +1,546 @@
+"""Unit layer of the wire-robustness stack (demodel_tpu/utils/faults.py):
+classification, backoff/deadline, breaker state machine, breaker-aware
+discovery/rotation — all with injected clocks and sleeps, no real waiting
+on any fast path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import requests
+
+from demodel_tpu.utils import faults as f
+from demodel_tpu.utils import metrics as m
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    f.PeerHealth.reset_shared()
+    m.HUB.reset()
+    yield
+    f.PeerHealth.reset_shared()
+
+
+# -------------------------------------------------------- classification
+
+
+def _http_error(status: int) -> requests.HTTPError:
+    r = requests.Response()
+    r.status_code = status
+    return requests.HTTPError(response=r)
+
+
+@pytest.mark.parametrize("exc, want", [
+    (requests.ConnectionError("refused"), True),
+    (requests.Timeout("read"), True),
+    (ConnectionResetError("rst"), True),
+    (TimeoutError("sock"), True),
+    (requests.exceptions.ChunkedEncodingError("mid-body"), True),
+    (f.TruncatedBody("short"), True),
+    (f.RangeIgnored("200 for a range"), False),  # failover-only, see below
+    (_http_error(429), True),
+    (_http_error(500), True),
+    (_http_error(503), True),
+    (_http_error(404), False),
+    (_http_error(403), False),
+    (f.DigestMismatch("poisoned"), False),
+    (f.BreakerOpen("open"), False),
+    (ValueError("junk json"), False),
+    (KeyError("shape"), False),
+])
+def test_retryable_classification(exc, want):
+    assert f.retryable(exc) is want
+
+
+@pytest.mark.parametrize("exc, want", [
+    (f.RangeIgnored("200 for a range"), True),   # another peer may range
+    (_http_error(404), True),                    # partially-warm peer
+    (_http_error(410), True),
+    (_http_error(503), False),                   # wire fault, not refusal
+    (_http_error(429), False),
+    (requests.ConnectionError("rst"), False),
+    (f.DigestMismatch("poison"), False),
+])
+def test_peer_cannot_serve_classification(exc, want):
+    """Content-shaped refusals are failover-eligible but never same-peer
+    retried and never health events — disjoint from retryable()."""
+    assert f.peer_cannot_serve(exc) is want
+    if want:
+        assert not f.retryable(exc)
+
+
+def test_window_fails_over_past_a_peer_missing_the_blob():
+    """A 404 from a failover peer mid-rotation must not abort the window
+    nor poison that peer's breaker — the read rotates on to the next
+    peer holding the key (the rotation deliberately includes
+    partially-warm peers)."""
+    from demodel_tpu.sink.remote import PeerBlobReader
+
+    payload = bytes(range(256)) * 64  # 16 KiB
+
+    class Missing(_CountingHandler):
+        def do_GET(self):
+            type(self).hits.append(self.path)
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    class Holder(_CountingHandler):
+        def do_GET(self):
+            type(self).hits.append(self.path)
+            rng = self.headers.get("Range", "")
+            start, end = 0, len(payload) - 1
+            if rng.startswith("bytes="):
+                a, b = rng.split("=")[1].split("-")
+                start, end = int(a), int(b or len(payload) - 1)
+                self.send_response(206)
+                self.send_header(
+                    "Content-Range",
+                    f"bytes {start}-{end}/{len(payload)}")
+            else:
+                self.send_response(200)
+            body = payload[start:end + 1]
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    h_miss = type("M", (Missing,), {"hits": []})
+    h_hold = type("H", (Holder,), {"hits": []})
+    srv_m = ThreadingHTTPServer(("127.0.0.1", 0), h_miss)
+    srv_h = ThreadingHTTPServer(("127.0.0.1", 0), h_hold)
+    for srv in (srv_m, srv_h):
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url_m = f"http://127.0.0.1:{srv_m.server_address[1]}"
+    url_h = f"http://127.0.0.1:{srv_h.server_address[1]}"
+    try:
+        health = f.PeerHealth(threshold=1, cooldown=60.0)
+        reader = PeerBlobReader(
+            url_m, "deadbeefdeadbeef", len(payload), failover=[url_h],
+            health=health, policy=f.RetryPolicy(max_attempts=3, deadline=30,
+                                                sleep=lambda s: None))
+        out = bytearray(4096)
+        n = reader.pread_into("deadbeefdeadbeef", out, offset=512)
+        assert n == 4096 and bytes(out) == payload[512:512 + 4096]
+        assert h_miss.hits, "the missing peer was never tried"
+        assert h_hold.hits, "the holding peer never served"
+        assert health.admissible(url_m), \
+            "a 404 poisoned the partially-warm peer's breaker"
+        # the whole key is now pinned to the holder: no more 404 churn
+        h_miss.hits.clear()
+        reader.pread_into("deadbeefdeadbeef", out, offset=0)
+        assert h_miss.hits == []
+    finally:
+        for srv in (srv_m, srv_h):
+            srv.shutdown()
+            srv.server_close()
+
+
+# ----------------------------------------------------------- RetryPolicy
+
+
+def _stub_policy(**kw) -> tuple[f.RetryPolicy, list, list]:
+    """Policy with a fake clock and recorded sleeps (no real waiting)."""
+    now = kw.pop("now", [0.0])
+    sleeps: list[float] = []
+
+    def sleep(s: float) -> None:
+        sleeps.append(s)
+        now[0] += s
+
+    pol = f.RetryPolicy(sleep=sleep, clock=lambda: now[0], **kw)
+    return pol, sleeps, now
+
+
+def test_retry_policy_retries_then_succeeds():
+    pol, sleeps, _ = _stub_policy(max_attempts=4, deadline=100,
+                                  base_delay=0.1)
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise ConnectionResetError("rst")
+        return "ok"
+
+    assert pol.call(flaky, what="unit") == "ok"
+    assert calls[0] == 3
+    assert len(sleeps) == 2
+
+
+def test_retry_policy_gives_up_at_attempt_cap():
+    pol, sleeps, _ = _stub_policy(max_attempts=3, deadline=100)
+    with pytest.raises(ConnectionResetError):
+        pol.call(lambda: (_ for _ in ()).throw(ConnectionResetError("x")))
+    assert len(sleeps) == 2  # 3 attempts → 2 backoffs
+
+
+def test_retry_policy_nonretryable_raises_immediately():
+    pol, sleeps, _ = _stub_policy(max_attempts=5, deadline=100)
+    calls = [0]
+
+    def poisoned():
+        calls[0] += 1
+        raise f.DigestMismatch("bad bytes")
+
+    with pytest.raises(f.DigestMismatch):
+        pol.call(poisoned)
+    assert calls[0] == 1 and sleeps == []
+
+
+def test_retry_policy_is_deadline_aware():
+    """The deadline caps the whole operation even under a generous
+    attempt budget — and each backoff is clipped to what's left."""
+    pol, sleeps, now = _stub_policy(max_attempts=100, deadline=10,
+                                    base_delay=4.0, max_delay=100.0)
+    pol.rng.seed(7)
+    calls = [0]
+
+    def always():
+        calls[0] += 1
+        now[0] += 3.0  # each attempt burns wall clock
+        raise requests.Timeout("slow peer")
+
+    with pytest.raises(requests.Timeout):
+        pol.call(always)
+    assert calls[0] < 10, "deadline did not bound the retry loop"
+    assert now[0] <= 10 + 3 + pol.max_delay  # last attempt may straddle
+
+
+def test_full_jitter_bounds():
+    pol, _, _ = _stub_policy(max_attempts=5, deadline=100, base_delay=0.5,
+                             max_delay=3.0)
+    pol.rng.seed(0)
+    for attempt in range(1, 20):
+        d = pol.next_delay(attempt)
+        assert 0.0 <= d <= min(0.5 * 2 ** (attempt - 1), 3.0)
+
+
+def test_retry_counters_land_in_metrics():
+    pol, _, _ = _stub_policy(max_attempts=2, deadline=100)
+    with pytest.raises(ConnectionResetError):
+        pol.call(lambda: (_ for _ in ()).throw(ConnectionResetError("x")),
+                 peer="http://p:1", health=f.PeerHealth.shared())
+    name = m.labeled("peer_retries_total", peer="http://p:1")
+    assert m.HUB.get(name) == 1
+    assert f"demodel_{name}" in m.render()
+
+
+# -------------------------------------------------------- circuit breaker
+
+
+def _stub_health(threshold=3, cooldown=10.0):
+    now = [0.0]
+    return f.PeerHealth(threshold=threshold, cooldown=cooldown,
+                        clock=lambda: now[0]), now
+
+
+def test_breaker_opens_after_consecutive_failures():
+    h, _ = _stub_health(threshold=3)
+    p = "http://a:1"
+    for _ in range(2):
+        h.record_failure(p)
+        assert h.allow(p), "breaker tripped early"
+    h.record_failure(p)
+    assert not h.allow(p)
+    assert m.HUB.get(m.labeled("peer_breaker_open_total", peer=p)) == 1
+    assert m.HUB.get_gauge(
+        m.labeled("peer_breaker_state", peer=p)) == f.STATE_OPEN
+
+
+def test_breaker_success_resets_the_count():
+    h, _ = _stub_health(threshold=3)
+    p = "http://a:1"
+    for _ in range(2):
+        h.record_failure(p)
+    h.record_success(p)
+    for _ in range(2):
+        h.record_failure(p)
+    assert h.allow(p), "non-consecutive failures must not open"
+
+
+def test_breaker_half_open_admits_exactly_one_probe():
+    h, now = _stub_health(threshold=1, cooldown=10.0)
+    p = "http://a:1"
+    h.record_failure(p)
+    assert not h.allow(p)
+    now[0] = 9.9
+    assert not h.allow(p), "cooldown not elapsed"
+    now[0] = 10.1
+    assert h.allow(p), "half-open probe admitted"
+    assert m.HUB.get_gauge(
+        m.labeled("peer_breaker_state", peer=p)) == f.STATE_HALF_OPEN
+    assert not h.allow(p), "second concurrent probe must be refused"
+    h.record_success(p)
+    assert h.allow(p) and h.allow(p), "closed again after probe success"
+    assert m.HUB.get_gauge(
+        m.labeled("peer_breaker_state", peer=p)) == f.STATE_CLOSED
+
+
+def test_breaker_open_rearm_on_direct_dial_failure():
+    """Filter paths (admissible) never claim the probe slot, so a
+    still-dead peer gets dialed directly once its cooldown elapses —
+    that failure must RE-ARM the cooldown, or admissible() re-admits the
+    corpse to every rotation forever, one read-timeout at a time."""
+    h, now = _stub_health(threshold=1, cooldown=10.0)
+    p = "http://a:1"
+    h.record_failure(p)              # open at t=0
+    now[0] = 11.0
+    assert h.admissible(p)           # cooldown elapsed: filter readmits
+    h.record_failure(p)              # ...the direct dial fails at t=11
+    assert not h.admissible(p), "stale _opened_at readmitted a dead peer"
+    now[0] = 20.0
+    assert not h.admissible(p), "cooldown was not re-armed from t=11"
+    now[0] = 21.5
+    assert h.admissible(p)
+    # the re-arm is not a new open TRANSITION: the counter moved once
+    assert m.HUB.get(m.labeled("peer_breaker_open_total", peer=p)) == 1
+
+
+def test_breaker_failed_probe_reopens():
+    h, now = _stub_health(threshold=1, cooldown=10.0)
+    p = "http://a:1"
+    h.record_failure(p)
+    now[0] = 11
+    assert h.allow(p)       # the probe
+    h.record_failure(p)     # ...fails
+    assert not h.allow(p), "failed probe must re-open"
+    now[0] = 22
+    assert h.allow(p), "second cooldown, second probe"
+
+
+def test_healthy_filters_but_never_empties():
+    h, _ = _stub_health(threshold=1)
+    a, b = "http://a:1", "http://b:1"
+    h.record_failure(a)
+    assert h.healthy([a, b]) == [b]
+    h.record_failure(b)
+    # all open: the full list comes back — a rotation with zero sources
+    # would turn a brown-out into an outage
+    assert h.healthy([a, b]) == [a, b]
+
+
+def test_healthy_filter_does_not_burn_the_probe_slot():
+    """Filters are read-only: building a rotation any number of times
+    must leave the single half-open probe slot for the caller that
+    actually dials (allow)."""
+    h, now = _stub_health(threshold=1, cooldown=10.0)
+    p = "http://a:1"
+    h.record_failure(p)
+    now[0] = 11.0
+    for _ in range(5):
+        assert h.healthy([p]) == [p], "read-only filter must be repeatable"
+        assert h.admissible(p)
+    assert h.allow(p), "the real dialer still gets the probe slot"
+    assert not h.allow(p), "slot claimed exactly once"
+
+
+def test_policy_stops_retrying_when_breaker_opens_mid_loop():
+    h, _ = _stub_health(threshold=2)
+    pol, sleeps, _ = _stub_policy(max_attempts=10, deadline=1000)
+    calls = [0]
+
+    def dying():
+        calls[0] += 1
+        raise requests.ConnectionError("down")
+
+    with pytest.raises(requests.ConnectionError):
+        pol.call(dying, peer="http://a:1", health=h)
+    assert calls[0] == 2, "retries continued past the open breaker"
+
+
+# ------------------------------------------------- counting test servers
+
+
+class _CountingHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    hits: list  # class attr set per instance-type
+    payload: bytes = b"{}"
+
+    def log_message(self, *a):  # noqa: ARG002
+        pass
+
+    def do_GET(self):
+        type(self).hits.append(self.path)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(self.payload)))
+        self.end_headers()
+        self.wfile.write(self.payload)
+
+
+def _counting_server(payload: bytes = b"{}"):
+    handler = type("H", (_CountingHandler,), {"hits": [], "payload": payload})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}", handler
+
+
+def test_fetch_manifest_skips_open_breaker_peer():
+    """THE acceptance check: a breaker-open peer takes zero wire traffic
+    from manifest discovery until its half-open probe window."""
+    from demodel_tpu.delivery import manifest_key
+    from demodel_tpu.sink.remote import fetch_manifest
+
+    record = json.dumps({"name": "org/x", "source": "hf",
+                         "files": []}).encode()
+    srv_a, url_a, handler_a = _counting_server(record)
+    srv_b, url_b, handler_b = _counting_server(record)
+    try:
+        now = [0.0]
+        health = f.PeerHealth(threshold=1, cooldown=60.0,
+                              clock=lambda: now[0])
+        health.record_failure(url_a)  # opens (threshold 1)
+        peer, manifest = fetch_manifest(
+            [url_a, url_b], "org/x", health=health,
+            policy=f.RetryPolicy(max_attempts=1, deadline=5))
+        assert peer == url_b
+        assert handler_a.hits == [], \
+            f"open-breaker peer was dialed: {handler_a.hits}"
+        mkey = manifest_key("hf", "org/x")
+        assert handler_b.hits == [f"/peer/object/{mkey}"]
+
+        # cooldown elapses → the half-open probe goes back to A
+        now[0] = 61.0
+        peer2, _ = fetch_manifest(
+            [url_a, url_b], "org/x", health=health,
+            policy=f.RetryPolicy(max_attempts=1, deadline=5))
+        assert peer2 == url_a and len(handler_a.hits) == 1
+        assert health.allow(url_a), "successful probe must close"
+    finally:
+        for s in (srv_a, srv_b):
+            s.shutdown()
+            s.server_close()
+
+
+def test_peerset_locate_skips_open_breaker_peer():
+    """The striping/locate side of the same contract: an open peer's
+    index is never even requested."""
+    from demodel_tpu.parallel.peer import PeerSet
+
+    idx = json.dumps({"keys": [{"key": "aaaabbbbccccdddd"}]}).encode()
+    srv_a, url_a, handler_a = _counting_server(idx)
+    srv_b, url_b, handler_b = _counting_server(idx)
+    try:
+        now = [0.0]
+        health = f.PeerHealth(threshold=1, cooldown=60.0,
+                              clock=lambda: now[0])
+        health.record_failure(url_a)
+        ps = PeerSet([url_a, url_b], timeout=5, health=health,
+                     policy=f.RetryPolicy(max_attempts=1, deadline=5))
+        assert ps.locate("aaaabbbbccccdddd") == url_b
+        assert handler_a.hits == []
+        # cooldown over → A is probed again and wins (listed first)
+        now[0] = 61.0
+        ps2 = PeerSet([url_a, url_b], timeout=5, health=health,
+                      policy=f.RetryPolicy(max_attempts=1, deadline=5))
+        assert ps2.locate("aaaabbbbccccdddd") == url_a
+        assert len(handler_a.hits) == 1
+    finally:
+        for s in (srv_a, srv_b):
+            s.shutdown()
+            s.server_close()
+
+
+def test_striping_rotation_drops_open_peer():
+    """healthy() is what the sharded pull's per-file rotation uses: the
+    opened peer leaves the rotation, order otherwise preserved."""
+    h, now = _stub_health(threshold=1, cooldown=30.0)
+    a, b, c = "http://a:1", "http://b:1", "http://c:1"
+    h.record_failure(b)
+    assert h.healthy([a, b, c]) == [a, c]
+    now[0] = 31.0
+    assert h.healthy([a, b, c]) == [a, b, c]  # half-open probe readmits
+
+
+# -------------------------------------------------- request_with_retry
+
+
+def test_request_with_retry_ok_statuses_and_breaker_feed():
+    srv, url, handler = _counting_server(b"nope")
+    try:
+        health, _ = _stub_health(threshold=1)
+        r = f.request_with_retry(
+            requests, "GET", f"{url}/peer/object/missing0000000000",
+            policy=f.RetryPolicy(max_attempts=3, deadline=5),
+            health=health, peer=url, ok_statuses=(200,), timeout=5)
+        assert r.status_code == 200
+        assert health.allow(url), "2xx must record success"
+        assert len(handler.hits) == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_request_with_retry_404_is_an_answer_not_a_failure():
+    class NotFound(_CountingHandler):
+        def do_GET(self):
+            type(self).hits.append(self.path)
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    handler = type("H", (NotFound,), {"hits": []})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        health, _ = _stub_health(threshold=1)
+        r = f.request_with_retry(
+            requests, "GET", f"{url}/x",
+            policy=f.RetryPolicy(max_attempts=3, deadline=5),
+            health=health, peer=url, ok_statuses=(404,), timeout=5)
+        assert r.status_code == 404
+        assert len(handler.hits) == 1, "404 must not retry"
+        assert health.allow(url), "404 is an answer — breaker stays closed"
+        # without the pass-through it raises, still without retrying
+        with pytest.raises(requests.HTTPError):
+            f.request_with_retry(
+                requests, "GET", f"{url}/x",
+                policy=f.RetryPolicy(max_attempts=3, deadline=5),
+                timeout=5)
+        assert len(handler.hits) == 2
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ------------------------------------------------- _alive_peers loop fix
+
+
+def test_alive_peers_from_plain_thread(monkeypatch):
+    from demodel_tpu.sink import remote
+
+    srv, url, _h = _counting_server(b"ok")
+    try:
+        assert remote._alive_peers([url], timeout=5) == [url]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_alive_peers_inside_running_event_loop():
+    """Regression: calling _alive_peers from a coroutine's thread used to
+    die with RuntimeError('asyncio.run() cannot be called from a running
+    event loop') — it must fall back to thread-pool probing and return
+    the same answer."""
+    import asyncio
+    import socket
+
+    from demodel_tpu.sink import remote
+
+    srv, url, _h = _counting_server(b"ok")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    try:
+        async def runner():
+            return remote._alive_peers([url, dead], timeout=5)
+
+        assert asyncio.run(runner()) == [url]
+    finally:
+        srv.shutdown()
+        srv.server_close()

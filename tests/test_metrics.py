@@ -75,6 +75,65 @@ def test_render_types_pool_gauges_as_gauges():
     assert "demodel_proxy_serve_bytes_total 4096" in body
 
 
+def test_labeled_counters_and_gauges_typed_correctly():
+    """The wire-robustness metrics: retry/breaker-open counters and the
+    per-peer breaker-state gauge render with the right TYPE lines, one
+    per base metric (labeled samples share it)."""
+    m.HUB.reset()
+    try:
+        m.HUB.inc(m.labeled("peer_retries_total", peer="http://a:8080"))
+        m.HUB.inc(m.labeled("peer_retries_total", peer="http://b:8080"), 3)
+        m.HUB.inc(m.labeled("peer_breaker_open_total", peer="http://a:8080"))
+        m.HUB.set_gauge(m.labeled("peer_breaker_state", peer="http://a:8080"),
+                        2)
+        m.HUB.set_gauge(m.labeled("peer_breaker_state", peer="http://b:8080"),
+                        0)
+        body = m.render()
+        assert body.count("# TYPE demodel_peer_retries_total counter") == 1
+        assert body.count("# TYPE demodel_peer_breaker_state gauge") == 1
+        assert "# TYPE demodel_peer_breaker_open_total counter" in body
+        assert 'demodel_peer_retries_total{peer="http://a:8080"} 1' in body
+        assert 'demodel_peer_retries_total{peer="http://b:8080"} 3' in body
+        assert 'demodel_peer_breaker_state{peer="http://a:8080"} 2' in body
+        assert 'demodel_peer_breaker_state{peer="http://b:8080"} 0' in body
+    finally:
+        m.HUB.reset()
+
+
+def test_breaker_transitions_drive_the_metrics_surface():
+    """State changes in a live breaker land on the scrape: open bumps the
+    counter and the gauge, the half-open probe and the close move the
+    gauge back down."""
+    from demodel_tpu.utils import faults as f
+
+    m.HUB.reset()
+    try:
+        now = [0.0]
+        health = f.PeerHealth(threshold=2, cooldown=5.0,
+                              clock=lambda: now[0])
+        peer = "http://peer-x:9"
+        state = m.labeled("peer_breaker_state", peer=peer)
+        opened = m.labeled("peer_breaker_open_total", peer=peer)
+        health.record_failure(peer)
+        health.record_failure(peer)          # → open
+        assert m.HUB.get(opened) == 1
+        assert m.HUB.get_gauge(state) == f.STATE_OPEN
+        now[0] = 6.0
+        assert health.allow(peer)            # → half-open probe
+        assert m.HUB.get_gauge(state) == f.STATE_HALF_OPEN
+        health.record_success(peer)          # → closed
+        assert m.HUB.get_gauge(state) == f.STATE_CLOSED
+        assert m.HUB.get(opened) == 1        # the counter is transitions
+        assert "demodel_peer_breaker_open_total" in m.render()
+    finally:
+        m.HUB.reset()
+
+
+def test_labeled_escapes_prometheus_specials():
+    name = m.labeled("peer_retries_total", peer='http://a/"b"\nc')
+    assert name == 'peer_retries_total{peer="http://a/\\"b\\"\\nc"}'
+
+
 def test_render_survives_broken_proxy():
     class Broken:
         def metrics(self):

@@ -38,6 +38,7 @@ ALL_RULES = {
     "unjoined-thread",
     "hbm-budget",
     "orphaned-async-task",
+    "wire-call-policy",
 }
 
 #: fixture file → exact expected (rule, line) findings
@@ -92,6 +93,12 @@ GOLDEN = {
         ("orphaned-async-task", 7),
         ("orphaned-async-task", 11),
         ("orphaned-async-task", 17),
+    },
+    "wire_bad.py": {
+        ("wire-call-policy", 15),
+        ("wire-call-policy", 19),
+        ("wire-call-policy", 23),
+        ("wire-call-policy", 27),
     },
     # the cross-module taint pair: silent when analyzed alone (neither
     # half shows both the device producer and the sync) — the findings

@@ -12,4 +12,5 @@ from tools.analyze.passes import (  # noqa: F401 — registration imports
     lock_order,
     log_hygiene,
     threads,
+    wire_policy,
 )

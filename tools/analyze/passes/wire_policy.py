@@ -1,0 +1,79 @@
+"""wire-call-policy: direct ``requests`` verb calls outside the faults layer.
+
+Every HTTP call on the pull/restore/registry plane must route through
+``demodel_tpu/utils/faults.py`` (``RetryPolicy`` + ``PeerHealth`` +
+``request_with_retry``): a direct ``requests.get/post/head`` is a
+single-attempt, breaker-blind call — exactly the shape the wire-plane
+fault-tolerance work removed. The rule covers the module imported under
+any alias (``import requests as rq``) and verbs pulled in directly
+(``from requests import get``).
+
+Scope: files under ``demodel_tpu/`` (minus the faults module itself) plus
+any file carrying an explicit ``# demodel: wire-plane`` pragma — which is
+how the golden fixture opts in, mirroring the host-sync ``hot-path``
+pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analyze.core import Finding, ModuleContext, Pass, register
+
+#: HTTP-issuing callables on the requests module / top-level API
+_VERBS = {"get", "post", "head", "put", "delete", "patch", "options",
+          "request"}
+
+_EXEMPT = "demodel_tpu/utils/faults.py"
+_PRAGMA = "# demodel: wire-plane"
+
+
+@register
+class WireCallPolicyPass(Pass):
+    id = "wire-call-policy"
+    description = (
+        "direct requests.get/post/head(...) in demodel_tpu/ outside "
+        "utils/faults.py — wire calls must ride the RetryPolicy/"
+        "PeerHealth layer (demodel_tpu.utils.faults)"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.rel == _EXEMPT:
+            return
+        if not (ctx.rel.startswith("demodel_tpu/")
+                or _PRAGMA in ctx.source):
+            return
+        module_aliases: set[str] = set()
+        verb_names: dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "requests":
+                        module_aliases.add(a.asname or "requests")
+            elif isinstance(node, ast.ImportFrom) and node.level == 0 \
+                    and node.module == "requests":
+                for a in node.names:
+                    if a.name in _VERBS:
+                        verb_names[a.asname or a.name] = a.name
+        if not module_aliases and not verb_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            verb = None
+            if (isinstance(fn, ast.Attribute) and fn.attr in _VERBS
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in module_aliases):
+                verb = fn.attr
+            elif isinstance(fn, ast.Name) and fn.id in verb_names:
+                verb = verb_names[fn.id]
+            if verb is not None:
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    f"direct requests.{verb}() is single-attempt and "
+                    "breaker-blind — route it through "
+                    "demodel_tpu.utils.faults (request_with_retry / "
+                    "RetryPolicy)",
+                )
