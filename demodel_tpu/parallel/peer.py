@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 import requests
 
 from demodel_tpu.store import Store
+from demodel_tpu.utils import trace
 from demodel_tpu.utils.env import env_int
 from demodel_tpu.utils.faults import (
     DigestMismatch,
@@ -139,13 +140,15 @@ class PeerSet:
         refreshed on miss). Open-breaker peers are skipped until their
         half-open probe succeeds — a dead friend must not cost every
         lookup a connect timeout; the upstream fallback covers the gap."""
-        for refresh in (False, True):
-            for peer in self.peers:
-                if not self._health.admissible(peer):
-                    continue  # read-only: index() may serve from cache
-                if key in self.index(peer, refresh=refresh):
-                    return peer
-        return None
+        with trace.span("peer-locate", key=key) as sp:
+            for refresh in (False, True):
+                for peer in self.peers:
+                    if not self._health.admissible(peer):
+                        continue  # read-only: index() may serve cached
+                    if key in self.index(peer, refresh=refresh):
+                        sp.set_attr("peer", peer)
+                        return peer
+            return None
 
     def locate_digest(self, digest: str) -> tuple[str, str] | None:
         """``(peer, their_key)`` for any object whose sha256 matches —
@@ -221,9 +224,12 @@ class PeerSet:
 
         def one_attempt() -> None:
             partial = store.partial_size(key)
-            headers = {}
+            headers: dict = {}
             if partial > 0:
                 headers["Range"] = f"bytes={partial}-"
+            # raw streaming GET (resume semantics live here, not in
+            # request_with_retry) — carry the ambient span's traceparent
+            headers = trace.inject_headers(headers) or headers
             r = self.session.get(f"{peer}/peer/object/{remote_key}",
                                  headers=headers, stream=True,
                                  timeout=max(self.timeout, 300))
@@ -253,10 +259,11 @@ class PeerSet:
                 # resume would queue behind the one it abandoned
                 r.close()
 
-        self._policy.call(
-            one_attempt, peer=peer, health=self._health,
-            what=f"peer object {remote_key} from {peer} "
-                 "(each retry resumes the kept partial)")
+        with trace.span("peer-stream", key=remote_key, peer=peer):
+            self._policy.call(
+                one_attempt, peer=peer, health=self._health,
+                what=f"peer object {remote_key} from {peer} "
+                     "(each retry resumes the kept partial)")
 
     def fetch_to_memory(self, key: str, expected_digest: str | None = None,
                         eager_verify: bool = True, budget=None):
@@ -310,15 +317,20 @@ class PeerSet:
             # host RAM is committed HERE — the budget gates allocation, not
             # just queue admission, so concurrent fetches of huge shards
             # wait before touching memory
-            budget.acquire(size)
+            with trace.span("budget-wait", bytes=size, key=remote_key):
+                budget.acquire(size)
         try:
             buf = np.empty(size, dtype=np.uint8)
             errbuf = ctypes.create_string_buffer(512)
-            n = native.lib().dm_peer_fetch_into(
-                host.encode(), port, f"/peer/object/{remote_key}".encode(),
-                size, _peer_streams(), (want if eager_verify else "").encode(),
-                buf.ctypes.data_as(ctypes.c_void_p), errbuf, 512,
-            )
+            with trace.span("peer-fetch-memory", key=remote_key,
+                            peer=peer, bytes=size):
+                n = native.lib().dm_peer_fetch_into(
+                    host.encode(), port,
+                    f"/peer/object/{remote_key}".encode(),
+                    size, _peer_streams(),
+                    (want if eager_verify else "").encode(),
+                    buf.ctypes.data_as(ctypes.c_void_p), errbuf, 512,
+                )
             if n != size:
                 log.warning("peer memory fetch of %s from %s failed: %s", key,
                             peer, errbuf.value.decode(errors="replace"))

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 import requests
 
 from demodel_tpu.store import Store, key_for_uri
+from demodel_tpu.utils import trace
 from demodel_tpu.utils.env import env_int
 from demodel_tpu.utils.faults import RetryPolicy, request_with_retry
 from demodel_tpu.utils.logging import get_logger
@@ -426,10 +427,15 @@ class Fetcher:
           from the kept partial — digest mismatches and other 4xx never
           retry.
         """
-        return self._policy.call(
-            lambda: self._fetch_once(url, name, expected_digest,
-                                     media_type, extra_headers),
-            what=f"fetch {name} (each retry resumes the kept partial)")
+        with trace.span("registry-fetch", file=name) as sp:
+            art = self._policy.call(
+                lambda: self._fetch_once(url, name, expected_digest,
+                                         media_type, extra_headers),
+                what=f"fetch {name} (each retry resumes the kept partial)")
+            sp.set_attr("bytes", art.size)
+            sp.set_attr("from_peer", art.from_peer)
+            sp.set_attr("from_cache", art.from_cache)
+            return art
 
     def _fetch_once(
         self,
@@ -592,5 +598,10 @@ def parallel_fetch(jobs: list, fn) -> list:
     resumable) but re-raises the first error after all workers settle."""
     if len(jobs) <= 1 or fetch_workers() == 1:
         return [fn(j) for j in jobs]
+    # trace.wrap PER JOB: worker threads don't inherit contextvars, and a
+    # contextvars.Context can only be entered by one thread at a time —
+    # one shared wrapped fn across the pool would raise "cannot enter
+    # context" on the first concurrent pair (identity when tracing is off)
     with ThreadPoolExecutor(max_workers=min(fetch_workers(), len(jobs))) as ex:
-        return list(ex.map(fn, jobs))
+        futs = [ex.submit(trace.wrap(fn), j) for j in jobs]
+        return [f.result() for f in futs]

@@ -19,6 +19,7 @@ from jax.sharding import Mesh
 
 from demodel_tpu.formats.safetensors import _np_dtype
 from demodel_tpu.parallel.mesh import make_mesh
+from demodel_tpu.utils import trace
 from demodel_tpu.utils.env import env_int
 from demodel_tpu.sink.hbm import Placement, place_tensor
 from demodel_tpu.sink.plan import ShardingPlan
@@ -49,6 +50,13 @@ def restore(
     timeout: float = 300.0,
 ) -> RestoreResult:
     """Restore ``model`` from a demodel-tpu ``/restore`` endpoint."""
+    with trace.span("restore", model=model, endpoint=endpoint):
+        return _restore(endpoint, model, mesh, plan, cast_to, session,
+                        timeout)
+
+
+def _restore(endpoint, model, mesh, plan, cast_to, session,
+             timeout) -> RestoreResult:
     if mesh is None:
         mesh = make_mesh()
     if plan is None:
@@ -74,6 +82,11 @@ def restore(
 
     def restore_one(item):
         name, info = item
+        with trace.span("tensor-restore", tensor=name,
+                        bytes=int(info["nbytes"])):
+            return _restore_one(name, info)
+
+    def _restore_one(name, info):
         shape = tuple(info["shape"])
         np_dtype = _np_dtype(info["dtype"])
         sharding = plan.sharding_for(name, shape, np_dtype.itemsize)
@@ -106,7 +119,15 @@ def restore(
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=workers) as ex:
-            for name, arr in ex.map(restore_one, items):
+            # trace.wrap PER ITEM: pool threads don't inherit contextvars
+            # (this keeps per-tensor spans under the restore root), and a
+            # contextvars.Context is single-entrant — one shared wrapped
+            # fn across concurrent workers would raise "cannot enter
+            # context"
+            futs = [ex.submit(trace.wrap(restore_one), item)
+                    for item in items]
+            for fut in futs:
+                name, arr = fut.result()
                 out.arrays[name] = arr
     else:
         for item in items:

@@ -28,7 +28,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from demodel_tpu.formats import safetensors as st
 from demodel_tpu.store import Store
-from demodel_tpu.utils import metrics
+from demodel_tpu.utils import metrics, trace
 from demodel_tpu.utils.logging import get_logger
 
 log = get_logger("restore")
@@ -355,6 +355,16 @@ def make_handler(registry: RestoreRegistry, proxy=None):
         def do_HEAD(self):
             self.do_GET()
 
+        def _traced(self, fn):
+            """Run one request handler under a server-side span, parented
+            on the client's W3C ``traceparent`` header when present — the
+            server half of the cross-host trace stitch. No-op (a shared
+            noop span, zero allocation) when tracing is disabled."""
+            with trace.span("serve.restore",
+                            remote_parent=self.headers.get("traceparent"),
+                            method=self.command, path=self.path):
+                return fn()
+
         def _content_length(self) -> int:
             try:
                 return int(self.headers.get("Content-Length", "0"))
@@ -362,6 +372,9 @@ def make_handler(registry: RestoreRegistry, proxy=None):
                 return 0
 
         def do_PUT(self):
+            self._traced(self._put)
+
+        def _put(self):
             # push surfaces for the network-Orbax save path:
             #   /restore/{model}/safetensors — one whole-checkpoint blob
             #   /restore/blob/{digest}       — one single-tensor blob,
@@ -399,6 +412,9 @@ def make_handler(registry: RestoreRegistry, proxy=None):
             self._send(200, json.dumps({"model": model, "tensors": n}).encode())
 
         def do_POST(self):
+            self._traced(self._post)
+
+        def _post(self):
             # finalize a streamed save: the ordered digest list becomes the
             # model registration (every blob must already be pushed)
             m = re.match(r"^/restore/(.+)/commit$", self.path)
@@ -423,6 +439,9 @@ def make_handler(registry: RestoreRegistry, proxy=None):
                                         "tensors": n}).encode())
 
         def do_GET(self):  # noqa: C901
+            self._traced(self._get)
+
+        def _get(self):  # noqa: C901
             if self.path == "/metrics":
                 # Prometheus exposition: hub counters + native proxy
                 # counters + store gauges (SURVEY.md §5 — the reference

@@ -38,6 +38,7 @@ import requests
 from demodel_tpu.delivery import manifest_key
 from demodel_tpu.sink.hbm import Placement, is_weight_file, merge_placement
 from demodel_tpu.sink.plan import ShardingPlan
+from demodel_tpu.utils import trace
 from demodel_tpu.utils.env import env_int
 from demodel_tpu.utils.faults import (
     PeerHealth,
@@ -183,6 +184,18 @@ class PeerBlobReader:
         if offset < 0 or offset + length > self._size:
             raise IOError(f"window [{offset}, {offset + length}) outside "
                           f"object of {self._size} bytes")
+        if not trace.enabled():
+            # span() args are evaluated eagerly — guard so the disabled
+            # hot path pays neither the attrs dict nor the _snapshot()
+            # lock acquire per window
+            return self._pread_into_traced(view, length, offset,
+                                           trace.NOOP)
+        with trace.span("window-read", key=self.remote_key, offset=offset,
+                        length=length, peer=self._snapshot()[0]) as sp:
+            return self._pread_into_traced(view, length, offset, sp)
+
+    def _pread_into_traced(self, view, length: int, offset: int,
+                           sp) -> int:
         got = 0
         attempt = 0
         start = self._policy.clock()
@@ -220,6 +233,13 @@ class PeerBlobReader:
                             f"{attempt} attempt(s): {e.cause}") from e.cause
                     count_retry(peer)
                     switched = self._fail_over(peer, exclude=cannot_serve)
+                    sp.event("retry", attempt=attempt, peer=peer,
+                             resume_at=got,
+                             error=f"{type(e.cause).__name__}: {e.cause}")
+                    if switched:
+                        sp.event("failover", from_peer=peer,
+                                 to_peer=self._snapshot()[0],
+                                 resume_at=got)
                     log.warning(
                         "window [%d, +%d) of %s died at +%d on %s (%s); "
                         "resuming at the exact offset via %s "
@@ -243,6 +263,9 @@ class PeerBlobReader:
                             f"window [{offset}, +{length}) of "
                             f"{self.remote_key}: no peer in the rotation "
                             f"can serve it ({e.cause})") from e.cause
+                    sp.event("failover", from_peer=peer,
+                             to_peer=self._snapshot()[0],
+                             reason="cannot-serve", resume_at=got)
                     log.warning(
                         "peer %s cannot serve %s (%s); failing the window "
                         "over to %s", peer, self.remote_key, e.cause,
@@ -288,9 +311,12 @@ class PeerBlobReader:
             s = self._tls.session = requests.Session()
         got = 0
         try:
-            r = s.get(f"{peer}{self.path}",
-                      headers={"Range":
-                               f"bytes={offset}-{offset + length - 1}"},
+            # the ambient window-read span's traceparent rides the raw
+            # streaming GET too (this path bypasses request_with_retry —
+            # resume semantics live in pread_into)
+            headers = trace.inject_headers(
+                {"Range": f"bytes={offset}-{offset + length - 1}"})
+            r = s.get(f"{peer}{self.path}", headers=headers,
                       stream=True, timeout=self.timeout)
             try:
                 r.raise_for_status()
@@ -336,6 +362,14 @@ def fetch_manifest(peers: list[str], model: str, source: str = "hf",
     health = health if health is not None else PeerHealth.shared()
     policy = policy if policy is not None else RetryPolicy()
     s = requests.Session()
+    with trace.span("manifest-discovery", model=model, source=source,
+                    peers=len(peers)):
+        return _fetch_manifest(peers, mkey, model, source, timeout,
+                               health, policy, s)
+
+
+def _fetch_manifest(peers, mkey, model, source, timeout, health, policy,
+                    s) -> tuple[str, dict]:
     last_err: Exception | None = None
     candidates = [p.rstrip("/") for p in peers]
     # read-only admission filter (burns no probe slots); the claiming
@@ -467,9 +501,11 @@ def _reader_and_index(f: dict, peer_order: list[str], streams):
             source_peer, f["key"], int(f["size"]), streams=streams,
             failover=peer_order[i + 1:] + peer_order[:i])
         try:
-            index = st.read_index_from(
-                lambda off, ln: reader.pread(f["key"], ln, off),
-                total_size=reader.size(f["key"]))
+            with trace.span("index-read", file=f["name"],
+                            peer=source_peer):
+                index = st.read_index_from(
+                    lambda off, ln: reader.pread(f["key"], ln, off),
+                    total_size=reader.size(f["key"]))
             return reader, index
         except (OSError, ValueError) as e:
             # ValueError: a corrupted/truncated safetensors header parses
@@ -551,27 +587,34 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
     admit_cv = threading.Condition()
 
     def fetch(job, idx):
-        reader, key, _name, spec = job
+        reader, key, name, spec = job
         nbytes = spec.end - spec.start
-        with admit_cv:
-            while admission["next"] != idx and not admission["dead"]:
-                admit_cv.wait()
-        try:
-            # charge before the bytes exist, so a worker blocks HERE
-            # rather than allocating past the budget; released after
-            # place()
-            budget.acquire(nbytes)
-        finally:
-            with admit_cv:
-                admission["next"] = idx + 1
-                admit_cv.notify_all()
-        try:
-            buf = np.empty(nbytes, dtype=np.uint8)
-            reader.pread_into(key, buf, spec.start)
-        except BaseException:
-            budget.release(nbytes)
-            raise
-        return buf
+        with trace.span("prefetch-fetch", tensor=name, bytes=nbytes,
+                        job=idx):
+            # the admission-ticket wait + budget charge together are the
+            # "waiting for RAM" stage of a slow pull — own span so the
+            # critical path can name it
+            with trace.span("budget-wait", bytes=nbytes):
+                with admit_cv:
+                    while admission["next"] != idx \
+                            and not admission["dead"]:
+                        admit_cv.wait()
+                try:
+                    # charge before the bytes exist, so a worker blocks
+                    # HERE rather than allocating past the budget;
+                    # released after place()
+                    budget.acquire(nbytes)
+                finally:
+                    with admit_cv:
+                        admission["next"] = idx + 1
+                        admit_cv.notify_all()
+            try:
+                buf = np.empty(nbytes, dtype=np.uint8)
+                reader.pread_into(key, buf, spec.start)
+            except BaseException:
+                budget.release(nbytes)
+                raise
+            return buf
 
     def place(buf, name, spec):
         mv = memoryview(buf)
@@ -584,8 +627,10 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
         if name in out.arrays:
             raise ValueError(f"duplicate tensor across shards: {name}")
         sharding = plan.sharding_for(name, spec.shape, np_dtype.itemsize)
-        out.arrays[name] = place_tensor(
-            read_at, spec.shape, np_dtype, spec.start, sharding, cast_to)
+        with trace.span("place", tensor=name, bytes=buf.nbytes):
+            out.arrays[name] = place_tensor(
+                read_at, spec.shape, np_dtype, spec.start, sharding,
+                cast_to)
 
     # phase accounting (exposed via the pull report): fetch wall vs
     # place wall tells whether a slow pull is network-bound or
@@ -624,7 +669,9 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
         # BEFORE that join runs — an outer handler would run after it,
         # i.e. after the deadlock
         try:
-            pending = [ex.submit(fetch, j, d)
+            # trace.wrap: executor threads don't inherit contextvars, so
+            # capture the pull span's context at the submit site
+            pending = [ex.submit(trace.wrap(fetch), j, d)
                        for d, j in enumerate(jobs[:prefetch_depth])]
             for i, (reader, key, name, spec) in enumerate(jobs):
                 t0 = time.perf_counter()
@@ -640,7 +687,8 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
                 t1 = time.perf_counter()
                 nxt = i + prefetch_depth
                 if nxt < len(jobs):
-                    pending.append(ex.submit(fetch, jobs[nxt], nxt))
+                    pending.append(ex.submit(trace.wrap(fetch),
+                                             jobs[nxt], nxt))
                 try:
                     place(buf, name, spec)
                 finally:
@@ -702,8 +750,12 @@ def pull_manifest_to_hbm(
         except Exception as e:  # noqa: BLE001 — tracing must never break a pull
             log.warning("jax.profiler trace not started: %s", e)
     try:
-        return _pull_manifest_to_hbm(model, peers, mesh, plan, source,
-                                     cast_to, ici_complete, streams)
+        # the ROOT span of a sharded pull: every window read, budget
+        # wait, retry and failover below stitches under this trace id —
+        # and across hosts via the traceparent the wire calls carry
+        with trace.span("pull", model=model, source=source):
+            return _pull_manifest_to_hbm(model, peers, mesh, plan, source,
+                                         cast_to, ici_complete, streams)
     finally:
         if profiling:
             try:

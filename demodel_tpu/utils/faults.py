@@ -36,7 +36,7 @@ from typing import Any, Callable, ClassVar, TypeVar
 
 import requests
 
-from demodel_tpu.utils import metrics
+from demodel_tpu.utils import metrics, trace
 from demodel_tpu.utils.env import env_int
 from demodel_tpu.utils.logging import get_logger
 
@@ -217,6 +217,9 @@ class RetryPolicy:
                     raise
                 delay = min(self.next_delay(attempt), max(0.0, left))
                 count_retry(peer)
+                trace.event("retry", attempt=attempt, peer=peer,
+                            error=f"{type(e).__name__}: {e}",
+                            backoff_secs=round(delay, 4))
                 log.warning("%s failed (%s: %s); retry %d/%d in %.2fs",
                             what or "wire call", type(e).__name__, e,
                             attempt, self.max_attempts - 1, delay)
@@ -336,6 +339,10 @@ class CircuitBreaker:
     def _set_state(self, state: int) -> None:
         # caller holds self._lock
         self._state = state
+        # the transition lands on whatever span drove the failing/probing
+        # call — the operation that PAID for it (no-op outside a span)
+        trace.event("breaker", peer=self.peer,
+                    state=_STATE_NAMES.get(state, str(state)))
         metrics.HUB.set_gauge(
             metrics.labeled("peer_breaker_state", peer=self.peer),
             float(state))
@@ -442,6 +449,11 @@ def request_with_retry(
     answer, not a failure); other non-2xx raise ``requests.HTTPError``,
     classified retryable for 429/5xx only. ``check_status=False`` returns
     whatever arrived (probes that read ``.ok`` themselves).
+
+    Tracing: the whole retried operation runs under one span (retry
+    attempts and breaker transitions land on it as events), and the
+    span's W3C ``traceparent`` rides the request headers — the server
+    side extracts it, so a multi-host pull stitches into one trace.
     """
     pol = policy if policy is not None else RetryPolicy()
 
@@ -451,5 +463,15 @@ def request_with_retry(
             r.raise_for_status()
         return r
 
-    return pol.call(one_attempt, what=what or f"{method} {url}",
-                    peer=peer, health=health)
+    def run() -> requests.Response:
+        return pol.call(one_attempt, what=what or f"{method} {url}",
+                        peer=peer, health=health)
+
+    if not trace.enabled():
+        return run()
+    with trace.span("http.request", method=method, url=url,
+                    peer=peer) as sp:
+        kw["headers"] = trace.inject_headers(kw.get("headers"))
+        r = run()
+        sp.set_attr("status", r.status_code)
+        return r

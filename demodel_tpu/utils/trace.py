@@ -1,0 +1,441 @@
+"""Dependency-free distributed tracing for the pull/serve/restore planes.
+
+The reference ships exactly one observability primitive — a response hook
+that prints (``cmd/demodel/start.go:201-204``, SURVEY.md §5) — and the
+rebuild's Prometheus counters (PR 2/4) say *that* a pull stalled, never
+*where*. This module answers "where did the 30 s go": budget wait? breaker
+cooldown? window retry? peer stream?
+
+Design, smallest-thing-that-works:
+
+- :class:`Span` — monotonic-clock timed, with attributes, timestamped
+  events (retry attempts, breaker transitions, failovers) and an error
+  status. Spans nest through ``contextvars`` so the ambient parent flows
+  through ``await`` points for free; :func:`wrap` captures the ambient
+  context for callables handed to thread pools (``contextvars`` does NOT
+  cross ``threading`` boundaries on its own).
+- :class:`TraceBuffer` — process-wide bounded ring of finished spans
+  (``DEMODEL_TRACE_BUFFER``, default 8192); the Chrome exporter and tests
+  read it back.
+- exporters — ``DEMODEL_TRACE=/path`` appends one JSON object per finished
+  span (the JSONL contract ``tools/trace_report.py`` consumes);
+  :func:`dump_chrome` / :func:`chrome_events` emit Chrome trace-event JSON
+  that loads in Perfetto (``ui.perfetto.dev``) / ``chrome://tracing``.
+- wire propagation — :func:`traceparent` / :func:`parse_traceparent`
+  implement the W3C header; the client side injects it at the
+  ``request_with_retry`` choke point (and the raw streaming GETs in
+  ``sink/remote`` / ``parallel/peer``), servers extract it and start a
+  child span, so a multi-host pull stitches into ONE trace.
+- span-duration summaries feed the existing metrics exposition:
+  ``trace_spans_total{span=...}`` / ``trace_span_seconds_total{span=...}``.
+
+Disabled tracing costs ~nothing: :func:`span` returns a shared no-op
+context manager after one module-global check — no allocation, no clock
+read — guarded by a microbenchmark in ``tests/test_trace.py``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import IO, Any, Callable
+
+#: ambient parent span (crosses asyncio awaits for free; for threads use
+#: :func:`wrap` at the submit site)
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "demodel_trace_span", default=None)
+
+_TRACEPARENT_VERSION = "00"
+_SAMPLED = "01"
+
+
+def _hex(nbytes: int) -> str:
+    return "%0*x" % (nbytes * 2, random.getrandbits(nbytes * 8))
+
+
+# ------------------------------------------------------------------ state
+
+
+class _State:
+    """Resolved-from-env exporter state. Rebuilt by :func:`reset`."""
+
+    def __init__(self) -> None:
+        path = os.environ.get("DEMODEL_TRACE", "").strip()
+        self.enabled = bool(path) or _FORCED
+        self.jsonl_path = path or None
+        self.buffer = TraceBuffer(_buffer_cap())
+        self._sink_lock = threading.Lock()
+        self._sink: IO[str] | None = None  # lazily opened JSONL file
+
+    def export(self, rec: dict[str, Any]) -> None:
+        self.buffer.add(rec)
+        if self.jsonl_path is None:
+            return
+        line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"
+        try:
+            with self._sink_lock:
+                if self._sink is None:
+                    # demodel: allow(no-blocking-io-under-lock) —
+                    # single-flight by design: this lock exists ONLY to
+                    # serialize appends to the one trace sink (interleaved
+                    # JSONL lines would corrupt the file); nothing else
+                    # ever waits on it
+                    self._sink = open(  # noqa: SIM115 — process lifetime
+                        self.jsonl_path, "a", encoding="utf-8")
+                self._sink.write(line)
+                self._sink.flush()
+        except OSError as e:
+            # tracing must never take the plane down: disable the sink,
+            # keep the in-memory buffer
+            self.jsonl_path = None
+            _log().warning("trace sink unusable (%s); JSONL export off", e)
+
+
+def _buffer_cap() -> int:
+    from demodel_tpu.utils.env import env_int
+
+    return env_int("DEMODEL_TRACE_BUFFER", 8192, minimum=16)
+
+
+def _log() -> logging.Logger:
+    from demodel_tpu.utils.logging import get_logger
+
+    return get_logger("trace")
+
+
+_FORCED = False           # enable() without an env var (tests/CLI)
+_state: _State | None = None
+_state_lock = threading.Lock()
+
+
+def _get_state() -> _State:
+    global _state
+    st = _state
+    if st is None:
+        with _state_lock:
+            st = _state
+            if st is None:
+                st = _state = _State()
+    return st
+
+
+def enabled() -> bool:
+    st = _state
+    return st.enabled if st is not None else _get_state().enabled
+
+
+def enable(jsonl_path: str | None = None) -> None:
+    """Force tracing on (tests / CLI), optionally with a JSONL sink."""
+    global _FORCED, _state
+    with _state_lock:
+        _FORCED = True
+        if jsonl_path is not None:
+            os.environ["DEMODEL_TRACE"] = jsonl_path
+        _state = None
+    _get_state()
+
+
+def reset() -> None:
+    """Drop exporter state and re-read the env (tests; cheap)."""
+    global _FORCED, _state
+    with _state_lock:
+        _FORCED = False
+        _state = None
+
+
+# ----------------------------------------------------------------- buffer
+
+
+class TraceBuffer:
+    """Bounded ring of finished-span records (dicts, newest last)."""
+
+    def __init__(self, cap: int) -> None:
+        self.cap = cap
+        self._lock = threading.Lock()
+        self._spans: deque[dict[str, Any]] = deque(maxlen=cap)
+        self.dropped = 0
+
+    def add(self, rec: dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._spans) == self.cap:
+                self.dropped += 1
+            self._spans.append(rec)
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._spans)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+def buffer() -> TraceBuffer:
+    return _get_state().buffer
+
+
+# ------------------------------------------------------------------- Span
+
+
+class Span:
+    """One timed operation. Use via ``with trace.span("window-read", ...):``
+    — entering makes it the ambient parent, exiting finishes + exports it.
+    An exception propagating through marks ``status=error`` (and records
+    the exception type/message) before re-raising."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
+                 "events", "status", "error", "_t0", "_wall0", "dur",
+                 "_token")
+
+    def __init__(self, name: str, trace_id: str, parent_id: str | None,
+                 attrs: dict[str, Any] | None) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _hex(8)
+        self.parent_id = parent_id
+        self.attrs: dict[str, Any] = attrs or {}
+        self.events: list[tuple[float, str, dict[str, Any]]] = []
+        self.status = "ok"
+        self.error: str | None = None
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+        self.dur: float | None = None
+        self._token: contextvars.Token["Span | None"] | None = None
+
+    # -- enrichment ----------------------------------------------------
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Timestamped point event on this span (retry attempt, breaker
+        transition, failover) — offset seconds from span start."""
+        self.events.append(
+            (round(time.perf_counter() - self._t0, 6), name, attrs))
+
+    # -- lifecycle -----------------------------------------------------
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self)
+        return self
+
+    def __exit__(self, exc_type: type[BaseException] | None,
+                 exc: BaseException | None, tb: object) -> None:
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        if exc is not None:
+            self.status = "error"
+            self.error = f"{type(exc).__name__}: {exc}"
+        self.finish()
+
+    def finish(self) -> None:
+        if self.dur is not None:
+            return  # idempotent: __exit__ after an explicit finish()
+        self.dur = time.perf_counter() - self._t0
+        th = threading.current_thread()
+        rec: dict[str, Any] = {
+            "trace": self.trace_id,
+            "span": self.span_id,
+            "parent": self.parent_id,
+            "name": self.name,
+            "ts": self._wall0,
+            "dur": round(self.dur, 6),
+            "pid": os.getpid(),
+            "tid": th.ident,
+            "thread": th.name,
+            "status": self.status,
+        }
+        if self.error is not None:
+            rec["error"] = self.error
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        if self.events:
+            rec["events"] = [
+                {"t": t, "name": n, **({"attrs": a} if a else {})}
+                for t, n, a in self.events]
+        _get_state().export(rec)
+        # span-duration summaries on the existing metrics surface: the
+        # scrape shows where pull time goes even when no sink is set
+        from demodel_tpu.utils import metrics
+
+        label = metrics.labeled("trace_spans_total", span=self.name)
+        metrics.HUB.inc(label)
+        metrics.HUB.inc(
+            metrics.labeled("trace_span_seconds_total", span=self.name),
+            self.dur)
+
+
+class _NoopSpan:
+    """The disabled-tracing fast path: one shared instance, every method a
+    constant-time no-op. ``span()`` returns it after a single module-global
+    check — the hot path allocates nothing and never reads a clock."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        return None
+
+    def set_attr(self, key: str, value: Any) -> None:
+        return None
+
+    def event(self, name: str, **attrs: Any) -> None:
+        return None
+
+    def finish(self) -> None:
+        return None
+
+
+NOOP = _NoopSpan()
+
+
+def span(name: str, remote_parent: str | None = None,
+         **attrs: Any) -> "Span | _NoopSpan":
+    """Start a span under the ambient parent (or a remote ``traceparent``
+    header value). Returns :data:`NOOP` when tracing is disabled."""
+    st = _state
+    if st is None:
+        st = _get_state()
+    if not st.enabled:
+        return NOOP
+    parent_trace: str | None = None
+    parent_id: str | None = None
+    if remote_parent is not None:
+        parsed = parse_traceparent(remote_parent)
+        if parsed is not None:
+            parent_trace, parent_id = parsed
+    if parent_trace is None:
+        cur = _current.get()
+        if cur is not None:
+            parent_trace, parent_id = cur.trace_id, cur.span_id
+    return Span(name, parent_trace or _hex(16), parent_id, attrs or None)
+
+
+def current() -> Span | None:
+    """The ambient span, or None (disabled or outside any span)."""
+    return _current.get()
+
+
+def event(name: str, **attrs: Any) -> None:
+    """Attach a point event to the ambient span (no-op without one) —
+    how RetryPolicy attempts and breaker transitions land on whichever
+    operation triggered them."""
+    cur = _current.get()
+    if cur is not None:
+        cur.event(name, **attrs)
+
+
+# ------------------------------------------------------------ propagation
+
+
+def traceparent() -> str | None:
+    """W3C ``traceparent`` value for the ambient span, or None."""
+    cur = _current.get()
+    if cur is None:
+        return None
+    return (f"{_TRACEPARENT_VERSION}-{cur.trace_id}-{cur.span_id}-"
+            f"{_SAMPLED}")
+
+
+def parse_traceparent(value: str) -> tuple[str, str] | None:
+    """``(trace_id, parent_span_id)`` from a traceparent header, or None
+    for anything malformed (never raises: header input is peer input)."""
+    parts = value.strip().split("-")
+    if len(parts) < 4:
+        return None
+    _ver, trace_id, span_id = parts[0], parts[1], parts[2]
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+        return None
+    return trace_id, span_id
+
+
+def inject_headers(headers: dict[str, str] | None) -> dict[str, str] | None:
+    """Return ``headers`` with ``traceparent`` added when a span is
+    ambient (copies before mutating; None stays None when no span)."""
+    tp = traceparent()
+    if tp is None:
+        return headers
+    out = dict(headers or {})
+    out.setdefault("traceparent", tp)
+    return out
+
+
+def wrap(fn: Callable[..., Any]) -> Callable[..., Any]:
+    """Capture the ambient trace context NOW for a callable that will run
+    on another thread (``contextvars`` does not cross ``threading``).
+    Identity when tracing is disabled — executor hot paths pay nothing."""
+    if not enabled() or _current.get() is None:
+        return fn
+    ctx = contextvars.copy_context()
+
+    def run(*a: Any, **kw: Any) -> Any:
+        return ctx.run(fn, *a, **kw)
+
+    return run
+
+
+# -------------------------------------------------------- chrome exporter
+
+
+def chrome_events(records: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Chrome trace-event objects (Perfetto/chrome://tracing) for finished
+    span records: one complete ("X") event per span, one instant ("i")
+    event per span event. Spans from different hosts of one pull carry
+    different pids, so a stitched multi-host trace lays out per-process."""
+    out: list[dict[str, Any]] = []
+    for r in records:
+        ts_us = r["ts"] * 1e6
+        args = dict(r.get("attrs") or {})
+        args["trace"] = r["trace"]
+        args["span"] = r["span"]
+        if r.get("parent"):
+            args["parent"] = r["parent"]
+        if r.get("error"):
+            args["error"] = r["error"]
+        out.append({
+            "name": r["name"],
+            "cat": "demodel",
+            "ph": "X",
+            "ts": ts_us,
+            "dur": max(r.get("dur", 0.0), 0.0) * 1e6,
+            "pid": r.get("pid", 0),
+            "tid": r.get("tid", 0) or 0,
+            "args": args,
+        })
+        for ev in r.get("events", ()):
+            out.append({
+                "name": f"{r['name']}:{ev['name']}",
+                "cat": "demodel",
+                "ph": "i",
+                "s": "t",
+                "ts": ts_us + ev.get("t", 0.0) * 1e6,
+                "pid": r.get("pid", 0),
+                "tid": r.get("tid", 0) or 0,
+                "args": dict(ev.get("attrs") or {}),
+            })
+    return out
+
+
+def dump_chrome(path: str,
+                records: list[dict[str, Any]] | None = None) -> int:
+    """Write a Chrome trace-event JSON file (records default to the
+    process buffer). Returns the event count."""
+    recs = records if records is not None else buffer().snapshot()
+    events = chrome_events(recs)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return len(events)

@@ -48,6 +48,8 @@ struct Api {
   int (*SSL_accept_)(SSL *);
   int (*SSL_connect_)(SSL *);
   int (*SSL_read_)(SSL *, void *, int);
+  int (*SSL_pending_)(const SSL *);
+  int (*SSL_has_pending_)(const SSL *);
   int (*SSL_write_)(SSL *, const void *, int);
   int (*SSL_shutdown_)(SSL *);
   int (*SSL_get_error_)(const SSL *, int);
@@ -109,6 +111,8 @@ inline Api &api() {
     DM_BIND(ssl, SSL_accept_, "SSL_accept");
     DM_BIND(ssl, SSL_connect_, "SSL_connect");
     DM_BIND(ssl, SSL_read_, "SSL_read");
+    DM_BIND(ssl, SSL_pending_, "SSL_pending");
+    DM_BIND(ssl, SSL_has_pending_, "SSL_has_pending");
     DM_BIND(ssl, SSL_write_, "SSL_write");
     DM_BIND(ssl, SSL_shutdown_, "SSL_shutdown");
     DM_BIND(ssl, SSL_get_error_, "SSL_get_error");
@@ -147,6 +151,8 @@ inline Api &api() {
 #define SSL_accept (dm_ssl::api().SSL_accept_)
 #define SSL_connect (dm_ssl::api().SSL_connect_)
 #define SSL_read (dm_ssl::api().SSL_read_)
+#define SSL_pending (dm_ssl::api().SSL_pending_)
+#define SSL_has_pending (dm_ssl::api().SSL_has_pending_)
 #define SSL_write (dm_ssl::api().SSL_write_)
 #define SSL_shutdown (dm_ssl::api().SSL_shutdown_)
 #define SSL_get_error (dm_ssl::api().SSL_get_error_)

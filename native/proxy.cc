@@ -316,7 +316,8 @@ std::string Metrics::json() const {
              "\"cache_hits\":%llu,\"cache_misses\":%llu,\"bytes_up\":%llu,"
              "\"bytes_down\":%llu,\"bytes_cache\":%llu,\"errors\":%llu,"
              "\"sessions_active\":%llu,\"sessions_queue_depth\":%llu,"
-             "\"sessions_rejected_total\":%llu,\"serve_bytes_total\":%llu}",
+             "\"sessions_rejected_total\":%llu,\"serve_bytes_total\":%llu,"
+             "\"sessions_idle_closed_total\":%llu}",
              (unsigned long long)connects.load(), (unsigned long long)mitm.load(),
              (unsigned long long)tunnel.load(), (unsigned long long)requests.load(),
              (unsigned long long)cache_hits.load(), (unsigned long long)cache_misses.load(),
@@ -325,7 +326,8 @@ std::string Metrics::json() const {
              (unsigned long long)sessions_active.load(),
              (unsigned long long)sessions_queue_depth.load(),
              (unsigned long long)sessions_rejected.load(),
-             (unsigned long long)serve_bytes.load());
+             (unsigned long long)serve_bytes.load(),
+             (unsigned long long)sessions_idle_closed.load());
   return buf;
 }
 
@@ -377,9 +379,42 @@ class Session {
     if (upstream_.fd >= 0) ::shutdown(upstream_.fd, SHUT_RDWR);
   }
 
+  // Between keep-alive requests (and before the very first one): wait at
+  // most the idle timeout for the next request head, so an idle client
+  // session cannot pin a bounded-pool worker for its connection's whole
+  // lifetime (the ROADMAP serve-plane item — on a 1-2 CPU host a handful
+  // of idle keep-alive sessions used to pin EVERY worker and queue new
+  // connections ~30 s). Already-buffered bytes (pipelined requests, TLS
+  // records SSL_read over-pulled) count as ready.
+  bool await_next_request() {
+    if (client_.rpos < client_.rbuf.size()) return true;
+    // SSL_pending counts bytes in the CURRENT processed record only; a
+    // pipelined request whose record was pulled into OpenSSL's read
+    // buffer but not yet processed is invisible to it (and to poll —
+    // the kernel already delivered the bytes). SSL_has_pending sees
+    // both, so an already-received request is never idle-closed away.
+    if (client_.ssl && (SSL_pending(client_.ssl) > 0 ||
+                        SSL_has_pending(client_.ssl)))
+      return true;
+    int timeout_ms = p_->idle_timeout_sec() * 1000;
+    if (timeout_ms >= p_->cfg_.io_timeout_sec * 1000)
+      return true;  // idle bound ≥ io timeout: SO_RCVTIMEO governs
+    struct pollfd pfd = {client_.fd, POLLIN, 0};
+    for (;;) {
+      int rc = ::poll(&pfd, 1, timeout_ms);
+      if (rc > 0) return true;  // readable OR hup/err: let the read see it
+      if (rc == 0) {
+        p_->metrics_.sessions_idle_closed++;
+        return false;  // idle past the bound: release this worker
+      }
+      if (errno != EINTR) return false;
+    }
+  }
+
   void run() {
     RequestHead req;
     client_.head_mode = true;  // see Conn::head_mode
+    if (!await_next_request()) return;
     if (!parse_request_head(&client_, &req)) return;
     client_.head_mode = false;
     if (req.method == "CONNECT") {
@@ -517,6 +552,7 @@ class Session {
     // serve decrypted requests until close
     for (;;) {
       RequestHead req;
+      if (!await_next_request()) return;
       if (!parse_request_head(&client_, &req)) return;
       if (!serve_one(req, "https", authority, host, port, /*tls=*/true)) return;
       p_->maybe_gc();
@@ -564,6 +600,7 @@ class Session {
           // idle node must not fabricate serve traffic)
           p_->metrics_.serve_bytes += body.size();
           RequestHead next;
+          if (!await_next_request()) return;
           if (!parse_request_head(&client_, &next)) return;
           req = next;
           continue;
@@ -587,6 +624,7 @@ class Session {
             return;
           p_->metrics_.serve_bytes += meta.size();
           RequestHead next;
+          if (!await_next_request()) return;
           if (!parse_request_head(&client_, &next)) return;
           req = next;
           continue;
@@ -599,6 +637,7 @@ class Session {
           }
           if (!serve_from_cache(req, req.target, key)) return;
           RequestHead next;
+          if (!await_next_request()) return;
           if (!parse_request_head(&client_, &next)) return;
           req = next;
           continue;
@@ -620,6 +659,7 @@ class Session {
             }
             if (!serve_tensor_window(req, loc)) return;
             RequestHead next;
+            if (!await_next_request()) return;
             if (!parse_request_head(&client_, &next)) return;
             req = next;
             continue;
@@ -651,6 +691,7 @@ class Session {
       p_->maybe_gc();
       if (lower(req.headers.get("connection")) == "close") return;
       RequestHead next;
+      if (!await_next_request()) return;
       if (!parse_request_head(&client_, &next)) return;
       req = next;
     }
@@ -2114,6 +2155,13 @@ int Proxy::start() {
                                     : env_pos_int("DEMODEL_PROXY_QUEUE");
   if (qcap <= 0) qcap = std::max(16, 4 * session_threads_);
   session_queue_cap_ = static_cast<size_t>(qcap);
+  // keep-alive idle bound: explicit config wins, then env, then 5 s —
+  // small relative to io_timeout so idle sessions release workers fast,
+  // large relative to request interarrival on a live connection
+  idle_timeout_sec_ = cfg_.idle_timeout_sec > 0
+                          ? cfg_.idle_timeout_sec
+                          : env_pos_int("DEMODEL_PROXY_IDLE_TIMEOUT");
+  if (idle_timeout_sec_ <= 0) idle_timeout_sec_ = 5;
 
   running_ = true;
   workers_.reserve(static_cast<size_t>(session_threads_));

@@ -67,6 +67,16 @@ struct ProxyConfig {
   // accept-queue bound; 0 = auto: env DEMODEL_PROXY_QUEUE, else
   // max(16, 4×session_threads). Overflow is answered 503 + Retry-After.
   int session_queue = 0;
+  // Keep-alive idle timeout (seconds). A pool worker owns its connection
+  // for the connection's WHOLE keep-alive lifetime, so an idle client
+  // session used to pin a worker until io_timeout (the ROADMAP serve-plane
+  // item the chaos tests masked with DEMODEL_PROXY_THREADS=16): between
+  // requests the worker now waits at most this long for the next request
+  // head, then closes the connection and returns to the pool — the client
+  // reconnects on its next request, standard HTTP keep-alive behavior.
+  // 0 = auto: env DEMODEL_PROXY_IDLE_TIMEOUT, else 5. Values ≥ io_timeout
+  // effectively restore the old pin-until-io-timeout behavior.
+  int idle_timeout_sec = 0;
 };
 
 struct Metrics {
@@ -78,8 +88,11 @@ struct Metrics {
   // serve_bytes_total counts every body byte served to clients out of the
   // local store (peer index/meta/object, tensor windows, cached replays,
   // fill-attach) — the hot-hit delivery volume.
+  // sessions_idle_closed counts keep-alive connections the idle timeout
+  // released back to the pool (a high rate with a saturated pool means
+  // clients hold connections open without using them).
   std::atomic<uint64_t> sessions_active{0}, sessions_queue_depth{0},
-      sessions_rejected{0}, serve_bytes{0};
+      sessions_rejected{0}, serve_bytes{0}, sessions_idle_closed{0};
   std::string json() const;
 };
 
@@ -130,6 +143,7 @@ class Proxy {
   // refreshed from live state — what /metrics and dm_proxy_metrics serve
   std::string metrics_json();
   int session_threads() const { return session_threads_; }
+  int idle_timeout_sec() const { return idle_timeout_sec_; }
 
   bool should_mitm(const std::string &authority) const;
   SSL_CTX *leaf_ctx(const std::string &host, std::string *err);
@@ -202,6 +216,7 @@ class Proxy {
   std::vector<std::thread> workers_;
   int session_threads_ = 0;   // resolved pool size (start())
   size_t session_queue_cap_ = 0;
+  int idle_timeout_sec_ = 5;  // resolved keep-alive idle bound (start())
 };
 
 }  // namespace dm

@@ -327,6 +327,11 @@ static void test_session_pool(const std::string &root) {
   // worker and let a reject probe slip into the queue (flaky under the
   // TSan build's 5-15× slowdown); teardown relies on force_close, not this
   cfg.io_timeout_sec = 60;
+  // this scenario DEPENDS on idle sessions pinning workers (that's how it
+  // saturates the pool) — disable the keep-alive idle bound (≥ io_timeout
+  // restores the pin-until-io-timeout behavior; test_idle_timeout covers
+  // the bound itself)
+  cfg.idle_timeout_sec = 60;
   auto *p = new dm::Proxy(std::move(cfg));
   CHECK(p->start() == 0, "pool proxy start");
   CHECK(p->session_threads() == 4, "explicit pool size wins");
@@ -403,6 +408,75 @@ static void test_session_pool(const std::string &root) {
   delete p;
 }
 
+static void test_idle_timeout(const std::string &root) {
+  // DEMODEL_PROXY_IDLE_TIMEOUT semantics (ROADMAP serve-plane item): a
+  // keep-alive connection idle past the bound is CLOSED and its worker
+  // returns to the pool. Proven the sharp way: a 1-worker pool, one
+  // client that makes a request and then sits idle holding keep-alive —
+  // a second connection must still get served (within the idle bound,
+  // not the 60 s io timeout), and the idle client must see a clean FIN.
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/idlestore";
+  cfg.verbose = false;
+  cfg.session_threads = 1;
+  cfg.session_queue = 4;
+  cfg.io_timeout_sec = 60;
+  cfg.idle_timeout_sec = 1;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "idle proxy start");
+  CHECK(p->idle_timeout_sec() == 1, "explicit idle bound wins");
+  int port = p->port();
+  std::string body(2048, 'i');
+  {
+    std::string serr;
+    dm::Store *s = dm::Store::open(root + "/idlestore", &serr);
+    CHECK(s != nullptr, "idle store open");
+    CHECK(s->put("idleobj000000001", body.data(), (int64_t)body.size(),
+                 "{}", nullptr) == 0, "idle put");
+    delete s;
+  }
+
+  // conn A: one served request, then idle (keep-alive holds the worker)
+  int a = pool_connect(port);
+  CHECK(a >= 0, "idle conn connect");
+  const char *req =
+      "GET /peer/object/idleobj000000001 HTTP/1.1\r\nHost: x\r\n\r\n";
+  CHECK(::write(a, req, ::strlen(req)) == (ssize_t)::strlen(req),
+        "idle conn request");
+  std::string first;
+  char buf[4096];
+  while (first.find("\r\n\r\n") == std::string::npos ||
+         first.size() < first.find("\r\n\r\n") + 4 + body.size()) {
+    ssize_t n = ::read(a, buf, sizeof buf);
+    if (n <= 0) break;
+    first.append(buf, (size_t)n);
+  }
+  CHECK(first.find("200 OK") != std::string::npos, "idle conn first hit");
+
+  // conn B: with the worker pinned by A this would queue until A's fate
+  // is decided — the idle bound must decide it in ~1 s, not 60
+  auto t0 = std::chrono::steady_clock::now();
+  std::string second = pool_get(port, "/peer/object/idleobj000000001");
+  double secs = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0).count();
+  CHECK(second.find("200 OK") != std::string::npos,
+        "second conn served past idle client");
+  CHECK(secs < 30.0, "released within the idle bound, not io_timeout");
+
+  // A was closed with a FIN (read 0), not left dangling
+  ssize_t n = ::read(a, buf, sizeof buf);
+  CHECK(n == 0, "idle conn got FIN");
+  ::close(a);
+  std::string m = p->metrics_json();
+  CHECK(m.find("\"sessions_idle_closed_total\":") != std::string::npos &&
+            m.find("\"sessions_idle_closed_total\":0}") == std::string::npos,
+        "idle closes counted");
+  p->stop();
+  delete p;
+}
+
 static void test_peer_window_fetch(const std::string &root) {
   // a proxy whose store holds one ~8 MB object; windows of it are fetched
   // back through /peer/object with the multi-stream ranged fan-out — the
@@ -475,6 +549,7 @@ int main() {
   test_store_gc_pin_stress(root);
   test_proxy_lifecycle(root);
   test_session_pool(root);
+  test_idle_timeout(root);
   test_peer_window_fetch(root);
   if (failures) {
     ::fprintf(stderr, "%d failures\n", failures);

@@ -33,6 +33,8 @@ from random import Random
 
 import requests
 
+from demodel_tpu.utils import trace
+
 KINDS = ("reset-at-byte", "stall", "503-burst", "truncate", "corrupt")
 
 
@@ -208,12 +210,24 @@ class ChaosPeer:
             pass
 
     def _serve(self, h: BaseHTTPRequestHandler) -> None:
+        # the PEER half of the trace stitch: extract the client's W3C
+        # traceparent and serve under a child span, so a traced chaos
+        # pull shows client window-reads and the peer-side serves (and
+        # which got faulted) in ONE trace
+        with trace.span("serve.peer",
+                        remote_parent=h.headers.get("traceparent"),
+                        path=h.path,
+                        range=h.headers.get("Range", "")) as sp:
+            self._serve_traced(h, sp)
+
+    def _serve_traced(self, h: BaseHTTPRequestHandler, sp) -> None:
         with self._count_lock:
             self.requests_log.append((h.path, h.headers.get("Range", "")))
         fault = self.plan.take(h.path)
 
         if fault is not None and fault.kind == "503-burst":
             self.plan.record("503-burst", h.path)
+            sp.event("fault", kind="503-burst")
             body = b"chaos: injected 503"
             h.send_response(503)
             h.send_header("Retry-After", "0")
@@ -224,6 +238,7 @@ class ChaosPeer:
 
         if fault is not None and fault.kind == "stall":
             self.plan.record("stall", h.path)
+            sp.event("fault", kind="stall")
             deadline = time.monotonic() + fault.stall_secs
             while time.monotonic() < deadline and not self._stop.is_set():
                 time.sleep(0.05)
@@ -238,6 +253,9 @@ class ChaosPeer:
         headers = {"Connection": "close"}
         if "Range" in h.headers:
             headers["Range"] = h.headers["Range"]
+        if "traceparent" in h.headers:
+            # keep the stitch intact through the forward leg too
+            headers["traceparent"] = h.headers["traceparent"]
         try:
             # fresh request per call: handler threads run concurrently
             # (multi-stream window reads) and Session isn't thread-safe
@@ -268,6 +286,7 @@ class ChaosPeer:
         pos = self.plan.position(fault, len(body))
         if fault.kind == "corrupt":
             self.plan.record("corrupt", h.path, pos)
+            sp.event("fault", kind="corrupt", at_byte=pos)
             mutated = bytearray(body)
             mutated[pos] ^= 0xFF
             h.end_headers()
@@ -276,6 +295,7 @@ class ChaosPeer:
             return
         if fault.kind == "reset-at-byte":
             self.plan.record("reset-at-byte", h.path, pos)
+            sp.event("fault", kind="reset-at-byte", at_byte=pos)
             h.end_headers()
             h.wfile.write(body[:pos])
             h.wfile.flush()
@@ -285,6 +305,7 @@ class ChaosPeer:
         # truncate: full Content-Length promised, fewer bytes delivered,
         # clean FIN — the client must detect the short body and resume
         self.plan.record("truncate", h.path, pos)
+        sp.event("fault", kind="truncate", at_byte=pos)
         h.close_connection = True
         h.end_headers()
         h.wfile.write(body[:pos])

@@ -1,0 +1,26 @@
+"""Golden fixture: self-attribute receiver typing (PR 5).
+
+``self.client = Wire()`` in the constructor types the attribute, so
+``self.client.fetch()`` under a lock resolves THROUGH THE CALL GRAPH to
+``Wire.fetch``'s blocking summary. The seed's resolution (name
+heuristics only) saw an untyped receiver and stayed silent — nothing at
+the call site is named ``session`` or ``requests``.
+"""
+import threading
+
+import requests
+
+
+class Wire:
+    def fetch(self, url):
+        return requests.get(url, timeout=5)
+
+
+class Cache:
+    def __init__(self):
+        self.client = Wire()
+        self._lock = threading.Lock()
+
+    def warm(self, url):
+        with self._lock:
+            return self.client.fetch(url)

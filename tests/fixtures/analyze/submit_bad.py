@@ -1,0 +1,26 @@
+"""Golden fixture: executor-submit call-graph edges (PR 5).
+
+``ex.submit(push, url)`` contributes a call edge to ``push``, so the
+blocking-I/O effect summary flows through the worker-escaping call:
+``locked_flush`` holds a lock across ``flush``, whose only blocking work
+happens inside the callable it submits. The seed's call graph stopped at
+the submit boundary and the finding went dark.
+"""
+import threading
+
+import requests
+
+_lock = threading.Lock()
+
+
+def push(url):
+    return requests.get(url, timeout=5)
+
+
+def flush(ex, url):
+    return ex.submit(push, url)
+
+
+def locked_flush(ex, url):
+    with _lock:
+        return flush(ex, url)

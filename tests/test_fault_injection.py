@@ -53,11 +53,14 @@ def _fast_wire(monkeypatch):
     monkeypatch.setenv("DEMODEL_RETRY_BASE_MS", "20")
     monkeypatch.setenv("DEMODEL_RETRY_DEADLINE", "60")
     monkeypatch.setenv("DEMODEL_BREAKER_COOLDOWN", "1")
-    # the serve pool defaults to 2×CPUs and each worker owns one
-    # connection for its whole keep-alive lifetime: on a 1-CPU CI box the
-    # pull's idle sessions would pin both workers and serialize every
-    # shim forward behind a ~30 s queue wait — not the failure under test
-    monkeypatch.setenv("DEMODEL_PROXY_THREADS", "16")
+    # a short keep-alive idle bound instead of the old
+    # DEMODEL_PROXY_THREADS=16 pin: the pin only masked the serve-plane
+    # defect where an idle session pinned a pool worker for its whole
+    # keep-alive lifetime (ROADMAP). The idle timeout is the FIX — idle
+    # sessions release their worker within a second, so the default-sized
+    # pool serves the shim's forwards without 30 s queue waits even on a
+    # 1-CPU CI box
+    monkeypatch.setenv("DEMODEL_PROXY_IDLE_TIMEOUT", "1")
     PeerHealth.reset_shared()
     m.HUB.reset()
     yield

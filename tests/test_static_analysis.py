@@ -100,6 +100,14 @@ GOLDEN = {
         ("wire-call-policy", 23),
         ("wire-call-policy", 27),
     },
+    # PR 5 receiver-typing upgrades: blocking I/O reached only through a
+    # constructor-typed self-attribute / an executor-submit edge
+    "self_attr_bad.py": {
+        ("no-blocking-io-under-lock", 26),
+    },
+    "submit_bad.py": {
+        ("no-blocking-io-under-lock", 26),
+    },
     # the cross-module taint pair: silent when analyzed alone (neither
     # half shows both the device producer and the sync) — the findings
     # only exist when one ProjectIndex spans both files, asserted by
@@ -244,6 +252,147 @@ def test_cross_module_blocking_io_through_call_graph(tmp_path):
     active, _ = analyze_paths([tmp_path], root=tmp_path)
     hits = [(f.rule, f.path, f.line) for f in active]
     assert ("no-blocking-io-under-lock", "locky.py", 6) in hits, hits
+
+
+def test_self_attr_receiver_typing_cross_module(tmp_path):
+    """``self.client = Wire()`` types the attribute even when Wire lives
+    in ANOTHER module — `self.client.fetch()` under a lock resolves
+    through the import table + class table to the blocking summary."""
+    (tmp_path / "wire_mod.py").write_text(
+        "import requests\n"
+        "class Wire:\n"
+        "    def fetch(self, url):\n"
+        "        return requests.get(url, timeout=5)\n"
+    )
+    (tmp_path / "cache_mod.py").write_text(
+        "import threading\n"
+        "from wire_mod import Wire\n"
+        "class Cache:\n"
+        "    def __init__(self):\n"
+        "        self.client = Wire()\n"
+        "        self._lock = threading.Lock()\n"
+        "    def warm(self, url):\n"
+        "        with self._lock:\n"
+        "            return self.client.fetch(url)\n"
+    )
+    active, _ = analyze_paths([tmp_path], root=tmp_path)
+    hits = [(f.rule, f.path, f.line) for f in active]
+    assert ("no-blocking-io-under-lock", "cache_mod.py", 9) in hits, hits
+
+
+def test_param_assigned_self_attr_stays_untyped(tmp_path):
+    """Only CONSTRUCTOR-assigned attributes are typed — a param-assigned
+    attr must not grow speculative edges (under-approximation contract)."""
+    (tmp_path / "wire_mod.py").write_text(
+        "import requests\n"
+        "class Wire:\n"
+        "    def fetch(self, url):\n"
+        "        return requests.get(url, timeout=5)\n"
+    )
+    (tmp_path / "cache_mod.py").write_text(
+        "import threading\n"
+        "class Cache:\n"
+        "    def __init__(self, client):\n"
+        "        self.client = client\n"
+        "        self._lock = threading.Lock()\n"
+        "    def warm(self, url):\n"
+        "        with self._lock:\n"
+        "            return self.client.fetch(url)\n"
+    )
+    active, _ = analyze_paths([tmp_path], root=tmp_path)
+    assert not any(f.rule == "no-blocking-io-under-lock" for f in active), [
+        f.render() for f in active]
+
+
+def test_submit_edge_crosses_modules(tmp_path):
+    """``ex.submit(f, x)`` contributes a call edge to ``f`` even when
+    ``f`` is imported — a lock-held call into the submitting function
+    surfaces the worker's blocking I/O."""
+    (tmp_path / "io_mod.py").write_text(
+        "import requests\n"
+        "def push(url):\n"
+        "    return requests.get(url, timeout=5)\n"
+    )
+    (tmp_path / "queue_mod.py").write_text(
+        "import threading\n"
+        "from io_mod import push\n"
+        "_lock = threading.Lock()\n"
+        "def flush(ex, url):\n"
+        "    return ex.submit(push, url)\n"
+        "def locked_flush(ex, url):\n"
+        "    with _lock:\n"
+        "        return flush(ex, url)\n"
+    )
+    active, _ = analyze_paths([tmp_path], root=tmp_path)
+    hits = [(f.rule, f.path, f.line) for f in active]
+    assert ("no-blocking-io-under-lock", "queue_mod.py", 8) in hits, hits
+
+
+def test_submit_edges_stay_out_of_the_lock_graph(tmp_path):
+    """A lock acquired ON THE WORKER THREAD is concurrent with the
+    submitter, not nested inside its critical section — submit edges must
+    not fabricate lock-order cycles."""
+    (tmp_path / "workers.py").write_text(
+        "import threading\n"
+        "lock_a = threading.Lock()\n"
+        "lock_b = threading.Lock()\n"
+        "def work_b_then_a():\n"
+        "    with lock_b:\n"
+        "        with lock_a:\n"
+        "            return 1\n"
+        "def submits_under_a(ex):\n"
+        "    with lock_a:\n"
+        "        ex.submit(work_b_then_a)\n"   # a→(b→a) only via submit
+        "def plain_b(ex):\n"
+        "    with lock_b:\n"
+        "        return 2\n"
+    )
+    active, _ = analyze_paths([tmp_path], rule_ids=["lock-order"],
+                              root=tmp_path)
+    assert active == [], [f.render() for f in active]
+
+
+def test_budget_charge_resolves_through_typed_self_attr(tmp_path):
+    """hbm-budget's worker-buffer clause: a landing buffer charged via a
+    NON-budget-named attr (``self.gate``) whose type resolves to a
+    ByteBudget-shaped class counts as charged — and the untyped control
+    still fires."""
+    (tmp_path / "budget_mod.py").write_text(
+        "class ByteBudget:\n"
+        "    def __init__(self, cap):\n"
+        "        self.cap = cap\n"
+        "    def acquire(self, n):\n"
+        "        return n\n"
+    )
+    charged = (
+        "# demodel: sink-plane\n"
+        "import numpy as np\n"
+        "from budget_mod import ByteBudget\n"
+        "class Pipeline:\n"
+        "    def __init__(self, reader):\n"
+        "        self.gate = ByteBudget(1 << 30)\n"
+        "        self.reader = reader\n"
+        "    def run(self, jobs, ex):\n"
+        "        for j in jobs:\n"
+        "            ex.submit(self._fetch, j)\n"
+        "    def _fetch(self, spec):\n"
+        "        self.gate.acquire(spec.nbytes)\n"
+        "        buf = np.empty(spec.nbytes, dtype=np.uint8)\n"
+        "        self.reader.pread_into(buf, spec.start)\n"
+        "        return buf\n"
+    )
+    (tmp_path / "sink_mod.py").write_text(charged)
+    active, _ = analyze_paths([tmp_path], rule_ids=["hbm-budget"],
+                              root=tmp_path)
+    assert active == [], [f.render() for f in active]
+
+    # control: drop the charge — the same worker buffer must fire
+    (tmp_path / "sink_mod.py").write_text(
+        charged.replace("        self.gate.acquire(spec.nbytes)\n", ""))
+    active, _ = analyze_paths([tmp_path], rule_ids=["hbm-budget"],
+                              root=tmp_path)
+    assert [(f.rule, f.path) for f in active] == [
+        ("hbm-budget", "sink_mod.py")], [f.render() for f in active]
 
 
 def test_cross_module_lock_order_cycle(tmp_path):

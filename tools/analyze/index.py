@@ -18,10 +18,22 @@ Resolution levels (in order):
 - constructor-typed locals: ``r = PeerBlobReader(...); r.pread(...)``
   resolves through the local's known class.
 
-Receivers typed only at runtime (``self.attr.m()``, dict-dispatched
-callables) stay unresolved — passes treat unresolved calls as effect-free,
-keeping the analysis under-approximate (no speculative edges) like the
-seed's one-level resolution was.
+- **self-attribute receivers**: a constructor-assigned attribute type
+  (``self.budget = ByteBudget(...)`` in any method of the class) is
+  recorded in the index, so ``self.budget.acquire(...)`` resolves to
+  ``ByteBudget.acquire`` through the call graph instead of the old
+  name-heuristic — effect summaries (blocking, locks, budget charges)
+  flow through typed attributes;
+- **executor-submit edges**: ``ex.submit(f, x)`` (and
+  ``Thread(target=f)``) contribute a call-graph edge to ``f`` — the
+  submitted callable's effect summary flows through the worker-escaping
+  call, so e.g. blocking I/O reachable only via a submit still surfaces
+  at the submitting call site.
+
+Receivers typed only at runtime (param-assigned ``self.attr``,
+dict-dispatched callables) stay unresolved — passes treat unresolved
+calls as effect-free, keeping the analysis under-approximate (no
+speculative edges) like the seed's one-level resolution was.
 """
 
 from __future__ import annotations
@@ -63,6 +75,21 @@ _BLOCKING_EXACT = {"time.sleep", "open", "urlopen"}
 _BLOCKING_ATTRS = {"recv", "recvfrom", "sendall", "accept", "makefile",
                    "read_bytes", "write_bytes", "read_text", "write_text"}
 _HTTP_VERBS = {"get", "post", "put", "patch", "delete", "head", "request"}
+
+
+def _submitted_callable(call: ast.Call) -> ast.AST | None:
+    """The callable REFERENCE a worker-escaping call hands off, or None:
+    ``ex.submit(f, x)`` / ``pool.submit(f)`` → ``f``;
+    ``Thread(target=f)`` → ``f``; ``asyncio.to_thread(f, x)`` → ``f``."""
+    name = dotted(call.func) or ""
+    if (name == "submit" or name.endswith(".submit")
+            or name == "to_thread" or name.endswith(".to_thread")):
+        return call.args[0] if call.args else None
+    if name == "Thread" or name.endswith(".Thread"):
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+    return None
 
 
 def device_producer(call: ast.Call) -> bool:
@@ -171,6 +198,15 @@ class FunctionInfo:
     #: names the function's body passes to an executor/Thread (escaping
     #: callables — used by hbm-budget's concurrent-buffer clause)
     escapes_to_worker: set = field(default_factory=set)
+    #: resolved worker-escaping call edges: [(qname, raw, submit node)]
+    #: for ``ex.submit(f, ...)`` / ``Thread(target=f)`` /
+    #: ``asyncio.to_thread(f, ...)``. Kept SEPARATE from ``calls``:
+    #: work-shaped effects (blocking I/O) compose through them, but lock
+    #: ACQUISITION does not — a lock taken on the worker thread is
+    #: concurrent with the submitter, not nested inside its critical
+    #: section, so feeding it into the lock-order graph would fabricate
+    #: cycles.
+    submit_calls: list = field(default_factory=list)
 
 
 class ProjectIndex:
@@ -190,6 +226,10 @@ class ProjectIndex:
         self.by_node: dict[int, FunctionInfo] = {}
         #: class qname → {method name → function qname}
         self.classes: dict[str, dict[str, str]] = {}
+        #: class qname → {attr name → class qname} for constructor-assigned
+        #: attributes (``self.x = KnownClass(...)``) — what lets
+        #: ``self.x.m()`` resolve through the call graph
+        self.self_attr_types: dict[str, dict[str, str]] = {}
         #: module → {local alias → fully qualified target}
         self.aliases: dict[str, dict[str, str]] = {}
         #: rel path → {id(call node) → resolved qname} (for passes)
@@ -201,6 +241,9 @@ class ProjectIndex:
         self._memo_locks: dict = {}
         for ctx in self.contexts:
             self._collect_defs(ctx)
+        for ctx in self.contexts:
+            # needs the full class table, must precede body resolution
+            self._collect_self_attr_types(ctx)
         for ctx in self.contexts:
             self._collect_bodies(ctx)
 
@@ -263,6 +306,33 @@ class ProjectIndex:
         qual = ".".join(chain + [node.name]) if chain else node.name
         return f"{ctx.module}.{qual}", cls
 
+    def _collect_self_attr_types(self, ctx: "ModuleContext") -> None:
+        """Record constructor-assigned attribute types per class:
+        ``self.x = KnownClass(...)`` anywhere in the class's methods makes
+        ``self.x`` carry that type for receiver resolution. Only literal
+        constructor calls count (param-assigned attrs stay untyped — no
+        speculative edges)."""
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cq, _ = self._qname_of(ctx, node)
+            table = self.self_attr_types.setdefault(cq, {})
+            from tools.analyze.core import enclosing_class
+
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Attribute)
+                        and isinstance(sub.targets[0].value, ast.Name)
+                        and sub.targets[0].value.id == "self"
+                        and isinstance(sub.value, ast.Call)):
+                    continue
+                if enclosing_class(sub) is not node:
+                    continue  # a nested class's attrs are not ours
+                q = self._resolve_name(ctx, dotted(sub.value.func) or "")
+                if q in self.classes:
+                    table[sub.targets[0].attr] = q
+
     def _collect_bodies(self, ctx: "ModuleContext") -> None:
         res = self.resolution.setdefault(ctx.rel, {})
         own = self._owner.setdefault(ctx.rel, {})
@@ -280,7 +350,14 @@ class ProjectIndex:
                     if q is not None:
                         res[id(sub)] = q
                     own[id(sub)] = info
-                    self._note_effects(ctx, node, info, sub)
+                    self._note_effects(ctx, node, info, sub, q)
+                    tgt = _submitted_callable(sub)
+                    if tgt is not None:
+                        q2 = self._resolve_callable_ref(ctx, node, tgt,
+                                                        local_types)
+                        if q2 is not None:
+                            info.submit_calls.append(
+                                (q2, dotted(tgt), sub))
                 elif isinstance(sub, (ast.With, ast.AsyncWith)):
                     for item in sub.items:
                         lid = lock_id(ctx, item.context_expr,
@@ -359,6 +436,17 @@ class ProjectIndex:
                 cq, _ = self._qname_of(ctx, cls)
                 return self.classes.get(cq, {}).get(parts[1])
             return None
+        # self.attr.method() through the constructor-assigned attr type
+        if parts[0] == "self" and len(parts) == 3:
+            from tools.analyze.core import enclosing_class
+
+            cls = enclosing_class(call)
+            if cls is not None:
+                cq, _ = self._qname_of(ctx, cls)
+                attr_q = self.self_attr_types.get(cq, {}).get(parts[1])
+                if attr_q is not None:
+                    return self.classes.get(attr_q, {}).get(parts[2])
+            return None
         # constructor-typed local receiver: r.pread()
         if len(parts) == 2 and parts[0] in local_types:
             return self.classes.get(local_types[parts[0]], {}).get(parts[1])
@@ -379,8 +467,48 @@ class ProjectIndex:
                     return cand
         return None
 
+    def _resolve_callable_ref(self, ctx: "ModuleContext", fn: ast.AST,
+                              expr: ast.AST,
+                              local_types: dict[str, str]) -> str | None:
+        """Resolve a callable REFERENCE (not a call) — the ``f`` in
+        ``ex.submit(f, x)``. Same resolution levels as :meth:`_resolve`
+        minus the constructor arm (a class reference handed to a worker
+        is a construction, out of scope)."""
+        from tools.analyze.core import enclosing_class
+
+        name = dotted(expr)
+        if not name:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) in (2, 3):
+            cls = enclosing_class(expr)
+            if cls is None:
+                return None
+            cq, _ = self._qname_of(ctx, cls)
+            if len(parts) == 2:
+                return self.classes.get(cq, {}).get(parts[1])
+            attr_q = self.self_attr_types.get(cq, {}).get(parts[1])
+            if attr_q is not None:
+                return self.classes.get(attr_q, {}).get(parts[2])
+            return None
+        if len(parts) == 2 and parts[0] in local_types:
+            return self.classes.get(local_types[parts[0]], {}).get(parts[1])
+        resolved = self._resolve_name(ctx, name)
+        if resolved in self.functions:
+            return resolved
+        if len(parts) == 1:
+            scope_q, _ = self._qname_of(ctx, fn)
+            prefix = scope_q
+            while "." in prefix:
+                prefix = prefix.rsplit(".", 1)[0]
+                cand = f"{prefix}.{name}"
+                if cand in self.functions:
+                    return cand
+        return None
+
     def _note_effects(self, ctx: "ModuleContext", fn: ast.AST,
-                      info: FunctionInfo, call: ast.Call) -> None:
+                      info: FunctionInfo, call: ast.Call,
+                      resolved: str | None = None) -> None:
         name = dotted(call.func) or ""
         if info.blocking_direct is None:
             why = blocking_call(call, ctx)
@@ -388,7 +516,13 @@ class ProjectIndex:
                 info.blocking_direct = (call.lineno, why)
         if isinstance(call.func, ast.Attribute) \
                 and call.func.attr == "acquire" \
-                and BUDGETISH_RE.search(ctx.src(call.func.value)):
+                and (BUDGETISH_RE.search(ctx.src(call.func.value))
+                     or (resolved is not None
+                         and BUDGETISH_RE.search(resolved))):
+            # budget-charging detection: the receiver NAME matches (the
+            # seed heuristic), or the call RESOLVES — via constructor-typed
+            # locals / self-attrs — to a method of a budget-named class
+            # (``self.limiter = ByteBudget(...); self.limiter.acquire``)
             info.budget_acquire = True
         if name == "Thread" or name.endswith(".Thread") \
                 or name.endswith(("create_task", "ensure_future")):
@@ -396,11 +530,16 @@ class ProjectIndex:
         if name in DEVICE_ALLOCATORS or name in JNP_ALLOCATORS:
             info.allocs.append(AllocSite(
                 node=call, line=call.lineno, call_name=name))
-        # callables escaping to worker threads/executors
+        # callables escaping to worker threads/executors (bare names and
+        # same-class bound methods: ex.submit(self._fetch, job))
         if name.endswith(".submit") and call.args:
             tgt = call.args[0]
             if isinstance(tgt, ast.Name):
                 info.escapes_to_worker.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                info.escapes_to_worker.add(tgt.attr)
         if name == "Thread" or name.endswith(".Thread"):
             for kw in call.keywords:
                 if kw.arg == "target" and isinstance(kw.value, ast.Name):
@@ -449,7 +588,12 @@ class ProjectIndex:
             if info.blocking_direct is not None:
                 out = (*info.blocking_direct, qname)
             elif depth > 0:
-                for q, _raw, node in info.calls:
+                # submit_calls compose here too: blocking work a function
+                # hands to an executor still happens on its behalf (and a
+                # `.result()` wait makes it block for real) — while lock
+                # ACQUISITION deliberately does not flow through these
+                # edges (see FunctionInfo.submit_calls)
+                for q, _raw, node in info.calls + info.submit_calls:
                     if q is None or q == qname:
                         continue
                     sub = self.blocking(q, depth - 1)

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Critical-path report over a demodel trace JSONL — ONE JSON line.
+
+Reads the span records ``DEMODEL_TRACE=/path`` produced (one JSON object
+per finished span, the :mod:`demodel_tpu.utils.trace` contract), rebuilds
+the span tree per trace, and prints:
+
+- the **critical path** of the longest trace: walking back from the root
+  span's end, the chain of child spans that actually gated completion,
+  with each hop's duration and **self time** (duration not covered by its
+  own critical child) — "the 30 s went: 26 s budget-wait under
+  prefetch-fetch, 3 s window-read retries, 1 s place";
+- a **per-stage breakdown**: count / total / max seconds per span name
+  across the whole file — where wall-clock concentrates even off the
+  critical path.
+
+Same one-JSON-line contract as ``bench.py`` / ``tools/bench_serve.py`` so
+drivers can scrape it. ``--chrome out.json`` additionally converts the
+JSONL to Chrome trace-event format (loads in Perfetto / chrome://tracing).
+
+Usage::
+
+    python tools/trace_report.py /tmp/pull.jsonl
+    python tools/trace_report.py /tmp/pull.jsonl --chrome /tmp/pull.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+
+def load_records(path: Path) -> list[dict]:
+    """Parse the JSONL, skipping blank lines; malformed lines raise (the
+    smoke gate's whole point is 'the file parses')."""
+    records = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise SystemExit(f"{path}:{i}: bad trace line: {e}") from e
+            if not isinstance(rec, dict) or "span" not in rec:
+                raise SystemExit(f"{path}:{i}: not a span record")
+            records.append(rec)
+    return records
+
+
+def _trace_of(records: list[dict], trace_id: str) -> list[dict]:
+    return [r for r in records if r["trace"] == trace_id]
+
+
+def _roots(spans: list[dict]) -> list[dict]:
+    ids = {r["span"] for r in spans}
+    return [r for r in spans if not r.get("parent") or r["parent"] not in ids]
+
+
+def critical_path(spans: list[dict], root: dict) -> list[dict]:
+    """The chain of spans that gated ``root``'s completion.
+
+    Walk back from the root's end: the critical child is the one whose
+    END is latest (but not past the cursor); recurse into it, move the
+    cursor to its start, repeat among its earlier siblings. Each hop
+    reports ``secs`` (span duration) and ``self_secs`` (duration minus
+    the part covered by its own critical child) — self time is where the
+    wait actually happened."""
+    children: dict[str, list[dict]] = defaultdict(list)
+    for r in spans:
+        if r.get("parent"):
+            children[r["parent"]].append(r)
+
+    def end(r: dict) -> float:
+        return r["ts"] + r.get("dur", 0.0)
+
+    path: list[dict] = []
+
+    def walk(span: dict) -> float:
+        """Append span, recurse into its critical child; returns the
+        span's self time."""
+        kids = [k for k in children.get(span["span"], ())
+                if end(k) <= end(span) + 1e-9]
+        covered = 0.0
+        cursor = end(span)
+        # repeatedly take the child gating `cursor`, then continue among
+        # children that finished before it started. Each child is
+        # consumed at most once: a zero-duration span whose end equals
+        # the cursor would otherwise be re-selected forever.
+        chain = []
+        remaining = list(kids)
+        while True:
+            cands = [k for k in remaining if end(k) <= cursor + 1e-9]
+            if not cands:
+                break
+            nxt = max(cands, key=end)
+            remaining.remove(nxt)
+            chain.append(nxt)
+            covered += nxt.get("dur", 0.0)
+            cursor = nxt["ts"]
+        entry = {
+            "name": span["name"],
+            "secs": round(span.get("dur", 0.0), 6),
+            "self_secs": round(max(0.0, span.get("dur", 0.0) - covered), 6),
+        }
+        if span.get("status") == "error":
+            entry["error"] = span.get("error", "")
+        path.append(entry)
+        # only the GATING child (latest end) continues the critical path;
+        # earlier chain entries were concurrent cover, already accounted
+        if chain:
+            walk(chain[0])
+        return entry["self_secs"]
+
+    walk(root)
+    return path
+
+
+def stage_breakdown(records: list[dict]) -> dict:
+    stages: dict[str, dict] = {}
+    for r in records:
+        s = stages.setdefault(r["name"], {"count": 0, "total_secs": 0.0,
+                                          "max_secs": 0.0, "errors": 0})
+        d = r.get("dur", 0.0)
+        s["count"] += 1
+        s["total_secs"] += d
+        s["max_secs"] = max(s["max_secs"], d)
+        if r.get("status") == "error":
+            s["errors"] += 1
+    for s in stages.values():
+        s["total_secs"] = round(s["total_secs"], 6)
+        s["max_secs"] = round(s["max_secs"], 6)
+    return dict(sorted(stages.items(),
+                       key=lambda kv: -kv[1]["total_secs"]))
+
+
+def report(records: list[dict]) -> dict:
+    by_trace: dict[str, list[dict]] = defaultdict(list)
+    for r in records:
+        by_trace[r["trace"]].append(r)
+    # the headline trace: the one whose root span ran longest
+    best_root, best_trace = None, None
+    for tid, spans in by_trace.items():
+        for root in _roots(spans):
+            if best_root is None or root.get("dur", 0.0) > best_root.get(
+                    "dur", 0.0):
+                best_root, best_trace = root, tid
+    out = {
+        "metric": "trace_report",
+        "traces": len(by_trace),
+        "spans": len(records),
+        "events": sum(len(r.get("events", ())) for r in records),
+        "stages": stage_breakdown(records),
+    }
+    if best_root is not None and best_trace is not None:
+        out["trace"] = best_trace
+        out["wall_secs"] = round(best_root.get("dur", 0.0), 6)
+        out["critical_path"] = critical_path(by_trace[best_trace],
+                                             best_root)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", type=Path, help="trace JSONL (DEMODEL_TRACE)")
+    ap.add_argument("--chrome", type=Path, default=None,
+                    help="also write Chrome trace-event JSON here "
+                         "(open in Perfetto / chrome://tracing)")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.jsonl)
+    if not records:
+        raise SystemExit(f"{args.jsonl}: no span records")
+    out = report(records)
+    if args.chrome is not None:
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+        from demodel_tpu.utils.trace import chrome_events
+
+        events = chrome_events(records)
+        args.chrome.write_text(json.dumps(
+            {"traceEvents": events, "displayTimeUnit": "ms"}))
+        out["chrome_events"] = len(events)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
