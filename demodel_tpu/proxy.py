@@ -38,6 +38,8 @@ class ProxyServer:
         max_body_mb: int = 64,
         session_threads: int | None = None,
         session_queue: int | None = None,
+        reactor: bool | None = None,
+        max_conns: int | None = None,
     ):
         self.cfg = cfg
         if upstream_ca is None:
@@ -103,6 +105,11 @@ class ProxyServer:
             # then fall back to the affinity-aware default (2×CPUs)
             session_threads if session_threads is not None else 0,
             session_queue if session_queue is not None else 0,
+            # event-driven serve plane: None → -1 lets the native side
+            # resolve DEMODEL_PROXY_REACTOR (on by default; "0" disables);
+            # max_conns None → 0 resolves DEMODEL_PROXY_MAX_CONNS (4096)
+            (-1 if reactor is None else (1 if reactor else 0)),
+            max_conns if max_conns is not None else 0,
         )
         if not self._h:
             raise OSError("proxy allocation failed")
@@ -116,7 +123,7 @@ class ProxyServer:
             c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_char_p,
             c.c_char_p, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_int64,
             c.c_int64, c.c_int, c.c_int64, c.c_int, c.c_int, c.c_int,
-            c.c_int,
+            c.c_int, c.c_int, c.c_int,
         ]
         L.dm_proxy_new.restype = c.c_void_p
         L.dm_proxy_start.argtypes = [c.c_void_p]
@@ -180,8 +187,8 @@ class ProxyServer:
             self._h, f"{model}/{tensor}".encode())
 
     def metrics(self) -> dict:
-        buf = ctypes.create_string_buffer(1024)
-        self._lib.dm_proxy_metrics(self._h, buf, 1024)
+        buf = ctypes.create_string_buffer(2048)
+        self._lib.dm_proxy_metrics(self._h, buf, 2048)
         return json.loads(buf.value.decode())
 
     def wait(self) -> None:

@@ -36,6 +36,7 @@ from demodel_tpu.sink.hbm import (
 from demodel_tpu.sink.plan import ShardingPlan
 from demodel_tpu.store import Store
 from demodel_tpu.parallel.mesh import make_mesh
+from demodel_tpu.utils import trace
 from demodel_tpu.utils.env import env_int
 from demodel_tpu.utils.logging import get_logger
 
@@ -170,8 +171,15 @@ class StreamingSink:
             if not getattr(artifact, "budget_charged", False):
                 # standalone producers charge here; fetchers sharing the
                 # budget charged at allocation (the earlier, correct point)
-                self.budget.acquire(nbytes)
-        self._q.put((name, key, buffer, nbytes))
+                with trace.span("sink-budget-wait", file=name, bytes=nbytes):
+                    self.budget.acquire(nbytes)
+        # the sink worker is another thread, outside the submitting fetch
+        # span's contextvars — carry the parent across the queue as a
+        # traceparent so sink-deliver stitches into the pull trace, and
+        # carry the head-sampling verdict too (a sampled-out pull must not
+        # leak orphan sink-deliver roots from the worker side)
+        self._q.put((name, key, buffer, nbytes, trace.traceparent(),
+                     trace.subtree_suppressed()))
 
     # ---- consumer side
     def _set_err(self, e: BaseException) -> None:
@@ -189,7 +197,7 @@ class StreamingSink:
             item = self._q.get()
             if item is _DONE:
                 return
-            name, key, buffer, nbytes = item
+            name, key, buffer, nbytes, parent, suppressed = item
             try:
                 if self._get_err() is not None:
                     continue  # drain without working after first failure
@@ -203,9 +211,16 @@ class StreamingSink:
                     # (demodel_tpu.sink.remote.pull_manifest_to_hbm), where
                     # per-host reads are window-sized from the start and
                     # collective order is deterministic by construction.
-                    placed = deliver_file(self.store, name, key, self.mesh,
-                                          self.plan, self.cast_to,
-                                          buffer=buffer, ici_complete=False)
+                    deliver_span = (trace.NOOP if suppressed else
+                                    trace.span("sink-deliver",
+                                               remote_parent=parent,
+                                               file=name, bytes=nbytes))
+                    with deliver_span as sp:
+                        placed = deliver_file(self.store, name, key,
+                                              self.mesh, self.plan,
+                                              self.cast_to, buffer=buffer,
+                                              ici_complete=False)
+                        sp.set_attr("tensors", len(placed.arrays))
                     merge_placement(self.placement, placed)
                     log.debug("streamed %s → %d tensors", name,
                               len(placed.arrays))

@@ -70,8 +70,10 @@ def labeled(name: str, **labels: str | None) -> str:
     return f"{name}{{{inner}}}" if inner else name
 
 #: native proxy metrics that are point-in-time pool state, not monotonic
-#: counters — the session executor's live occupancy and queue depth
-PROXY_GAUGES = frozenset({"sessions_active", "sessions_queue_depth"})
+#: counters — the session executor's live occupancy, queue depth, and the
+#: reactor's parked keep-alive connections
+PROXY_GAUGES = frozenset({"sessions_active", "sessions_queue_depth",
+                          "sessions_parked"})
 
 
 def _fmt(value: float) -> str:

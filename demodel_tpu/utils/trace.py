@@ -69,6 +69,7 @@ class _State:
         path = os.environ.get("DEMODEL_TRACE", "").strip()
         self.enabled = bool(path) or _FORCED
         self.jsonl_path = path or None
+        self.sample = _sample_rate()
         self.buffer = TraceBuffer(_buffer_cap())
         self._sink_lock = threading.Lock()
         self._sink: IO[str] | None = None  # lazily opened JSONL file
@@ -101,6 +102,23 @@ def _buffer_cap() -> int:
     from demodel_tpu.utils.env import env_int
 
     return env_int("DEMODEL_TRACE_BUFFER", 8192, minimum=16)
+
+
+def _sample_rate() -> float:
+    """``DEMODEL_TRACE_SAMPLE`` ∈ [0, 1]: head-sampling probability for new
+    ROOT spans (default 1.0 — record everything). Multi-user serve traffic
+    sets e.g. ``0.01`` so tracing overhead/volume scales with the sample,
+    not the load. Malformed values degrade to 1.0, same policy as env_int."""
+    raw = os.environ.get("DEMODEL_TRACE_SAMPLE", "").strip()
+    if not raw:
+        return 1.0
+    try:
+        rate = float(raw)
+    except ValueError:
+        _log().warning("DEMODEL_TRACE_SAMPLE=%r is not a float; sampling "
+                       "everything", raw)
+        return 1.0
+    return min(1.0, max(0.0, rate))
 
 
 def _log() -> logging.Logger:
@@ -295,11 +313,48 @@ class _NoopSpan:
 
 NOOP = _NoopSpan()
 
+#: set while inside a head-UNSAMPLED root: descendants (including across
+#: :func:`wrap`-captured thread hops) are suppressed with it, so a sampling
+#: decision drops or keeps whole traces, never mid-trace fragments
+_unsampled: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "demodel_trace_unsampled", default=False)
+
+
+class _UnsampledRoot:
+    """Context manager for a head-sampled-OUT root span: records nothing,
+    but marks the context so every descendant span is suppressed too."""
+
+    __slots__ = ("_token",)
+
+    def __init__(self) -> None:
+        self._token: contextvars.Token[bool] | None = None
+
+    def __enter__(self) -> "_UnsampledRoot":
+        self._token = _unsampled.set(True)
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        if self._token is not None:
+            _unsampled.reset(self._token)
+            self._token = None
+
+    def set_attr(self, key: str, value: Any) -> None:
+        return None
+
+    def event(self, name: str, **attrs: Any) -> None:
+        return None
+
+    def finish(self) -> None:
+        return None
+
 
 def span(name: str, remote_parent: str | None = None,
-         **attrs: Any) -> "Span | _NoopSpan":
+         **attrs: Any) -> "Span | _NoopSpan | _UnsampledRoot":
     """Start a span under the ambient parent (or a remote ``traceparent``
-    header value). Returns :data:`NOOP` when tracing is disabled."""
+    header value). Returns :data:`NOOP` when tracing is disabled. New ROOT
+    spans are head-sampled per ``DEMODEL_TRACE_SAMPLE``: an unsampled root
+    suppresses its whole subtree; spans with a parent — ambient or remote
+    (the upstream host already made the keep decision) — are always kept."""
     st = _state
     if st is None:
         st = _get_state()
@@ -315,6 +370,12 @@ def span(name: str, remote_parent: str | None = None,
         cur = _current.get()
         if cur is not None:
             parent_trace, parent_id = cur.trace_id, cur.span_id
+    if parent_trace is None:
+        # new root: the one head-sampling decision for the whole trace
+        if _unsampled.get():
+            return NOOP
+        if st.sample < 1.0 and random.random() >= st.sample:
+            return _UnsampledRoot()
     return Span(name, parent_trace or _hex(16), parent_id, attrs or None)
 
 
@@ -342,6 +403,14 @@ def traceparent() -> str | None:
         return None
     return (f"{_TRACEPARENT_VERSION}-{cur.trace_id}-{cur.span_id}-"
             f"{_SAMPLED}")
+
+
+def subtree_suppressed() -> bool:
+    """True inside a head-UNSAMPLED root. Work fanned out from here over
+    channels contextvars cannot cross (queues, executors without
+    :func:`wrap`) must carry this flag and skip its spans, or a dropped
+    trace leaks orphan fragments from the far side of the channel."""
+    return _unsampled.get()
 
 
 def parse_traceparent(value: str) -> tuple[str, str] | None:
@@ -376,8 +445,10 @@ def inject_headers(headers: dict[str, str] | None) -> dict[str, str] | None:
 def wrap(fn: Callable[..., Any]) -> Callable[..., Any]:
     """Capture the ambient trace context NOW for a callable that will run
     on another thread (``contextvars`` does not cross ``threading``).
-    Identity when tracing is disabled — executor hot paths pay nothing."""
-    if not enabled() or _current.get() is None:
+    Identity when tracing is disabled — executor hot paths pay nothing.
+    An unsampled-root context is captured too, so a dropped trace's thread
+    fan-out doesn't re-roll the sampling dice per task."""
+    if not enabled() or (_current.get() is None and not _unsampled.get()):
         return fn
     ctx = contextvars.copy_context()
 

@@ -11,7 +11,8 @@
 // happens to fire.
 //
 // Rank order (low = outermost, must be acquired first):
-//   Proxy:  queue < sessions < fill < leaf < upstream < hint < restore
+//   Proxy:  reactor < queue < sessions < fill < leaf < upstream < hint
+//           < restore
 //   Store:  gc < writers < index < pin < fd
 // Proxy locks rank below Store locks because proxy paths call into the
 // store while holding their own locks (register_tensor holds restore_mu_
@@ -33,6 +34,7 @@
 namespace dm {
 
 // lock ranks (see ordering rationale above)
+constexpr int kRankProxyReactor = 6;
 constexpr int kRankProxyQueue = 8;
 constexpr int kRankProxySessions = 10;
 constexpr int kRankProxyFill = 12;

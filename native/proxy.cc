@@ -7,9 +7,12 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sched.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
 #include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -88,6 +91,42 @@ struct Conn {
       }
       p += n;
       len -= static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  // Vectored header+body write — one syscall and (under TCP_NODELAY) one
+  // TCP segment for small hot responses instead of write(head)+write(body)
+  // two-packet pairs. sendmsg rather than writev because only sendmsg
+  // carries MSG_NOSIGNAL; TLS keeps per-part SSL_write framing (records
+  // are framed per call anyway, and interleaving into one buffer would
+  // just add a copy).
+  bool writev_all(const void *head, size_t head_len, const void *body,
+                  size_t body_len) {
+    if (ssl || body_len == 0)
+      return write_all(head, head_len) &&
+             (body_len == 0 || write_all(body, body_len));
+    struct iovec iov[2] = {
+        {const_cast<void *>(head), head_len},
+        {const_cast<void *>(body), body_len},
+    };
+    size_t idx = 0;
+    while (idx < 2) {
+      struct msghdr mh = {};
+      mh.msg_iov = iov + idx;
+      mh.msg_iovlen = 2 - idx;
+      ssize_t n = ::sendmsg(fd, &mh, MSG_NOSIGNAL);
+      if (n < 0 && errno == EINTR) continue;
+      if (n <= 0) return false;
+      size_t left = static_cast<size_t>(n);
+      while (idx < 2 && left >= iov[idx].iov_len) {
+        left -= iov[idx].iov_len;
+        idx++;
+      }
+      if (idx < 2 && left > 0) {
+        iov[idx].iov_base = static_cast<char *>(iov[idx].iov_base) + left;
+        iov[idx].iov_len -= left;
+      }
     }
     return true;
   }
@@ -310,14 +349,15 @@ static int tcp_connect(const std::string &host, int port, int timeout_sec,
 }
 
 std::string Metrics::json() const {
-  char buf[768];
+  char buf[1024];
   ::snprintf(buf, sizeof buf,
              "{\"connects\":%llu,\"mitm\":%llu,\"tunnel\":%llu,\"requests\":%llu,"
              "\"cache_hits\":%llu,\"cache_misses\":%llu,\"bytes_up\":%llu,"
              "\"bytes_down\":%llu,\"bytes_cache\":%llu,\"errors\":%llu,"
              "\"sessions_active\":%llu,\"sessions_queue_depth\":%llu,"
              "\"sessions_rejected_total\":%llu,\"serve_bytes_total\":%llu,"
-             "\"sessions_idle_closed_total\":%llu}",
+             "\"sessions_idle_closed_total\":%llu,\"sessions_parked\":%llu,"
+             "\"reactor_wakeups_total\":%llu}",
              (unsigned long long)connects.load(), (unsigned long long)mitm.load(),
              (unsigned long long)tunnel.load(), (unsigned long long)requests.load(),
              (unsigned long long)cache_hits.load(), (unsigned long long)cache_misses.load(),
@@ -327,7 +367,9 @@ std::string Metrics::json() const {
              (unsigned long long)sessions_queue_depth.load(),
              (unsigned long long)sessions_rejected.load(),
              (unsigned long long)serve_bytes.load(),
-             (unsigned long long)sessions_idle_closed.load());
+             (unsigned long long)sessions_idle_closed.load(),
+             (unsigned long long)sessions_parked.load(),
+             (unsigned long long)reactor_wakeups.load());
   return buf;
 }
 
@@ -357,8 +399,13 @@ std::string jesc(const std::string &s) {
 
 class Session {
  public:
+  // What a serving step asks its owner to do with the connection next:
+  // close it, or hand it back to the reactor to park until readable.
+  enum class Disp { kClose, kPark };
+
   Session(Proxy *proxy, int client_fd) : p_(proxy) {
     client_.fd = client_fd;
+    p_->conn_count_++;
     std::lock_guard<Mutex> g(p_->sessions_mu_);
     p_->sessions_.insert(this);
   }
@@ -371,7 +418,14 @@ class Session {
     }
     client_.shutdown_close();
     upstream_.shutdown_close();
+    p_->conn_count_--;
   }
+
+  int client_fd() const { return client_.fd; }
+
+  // reactor-thread-only bookkeeping: whether this fd is registered in the
+  // epoll set (first park ADDs, re-parks MOD the oneshot re-arm)
+  bool epoll_armed = false;
 
   // Called by Proxy::stop() (under sessions_mu_) to unblock our IO.
   void force_close() {
@@ -379,23 +433,28 @@ class Session {
     if (upstream_.fd >= 0) ::shutdown(upstream_.fd, SHUT_RDWR);
   }
 
-  // Between keep-alive requests (and before the very first one): wait at
-  // most the idle timeout for the next request head, so an idle client
-  // session cannot pin a bounded-pool worker for its connection's whole
-  // lifetime (the ROADMAP serve-plane item — on a 1-2 CPU host a handful
-  // of idle keep-alive sessions used to pin EVERY worker and queue new
-  // connections ~30 s). Already-buffered bytes (pipelined requests, TLS
-  // records SSL_read over-pulled) count as ready.
-  bool await_next_request() {
+  // Bytes already received but not yet parsed: leftover rbuf from a
+  // pipelined request, or TLS data OpenSSL pulled off the socket.
+  // SSL_pending counts bytes in the CURRENT processed record only; a
+  // pipelined request whose record was pulled into OpenSSL's read buffer
+  // but not yet processed is invisible to it (and to poll/epoll — the
+  // kernel already delivered the bytes). SSL_has_pending sees both, so a
+  // connection with an already-received request is never parked away.
+  bool input_buffered() {
     if (client_.rpos < client_.rbuf.size()) return true;
-    // SSL_pending counts bytes in the CURRENT processed record only; a
-    // pipelined request whose record was pulled into OpenSSL's read
-    // buffer but not yet processed is invisible to it (and to poll —
-    // the kernel already delivered the bytes). SSL_has_pending sees
-    // both, so an already-received request is never idle-closed away.
-    if (client_.ssl && (SSL_pending(client_.ssl) > 0 ||
-                        SSL_has_pending(client_.ssl)))
-      return true;
+    return client_.ssl && (SSL_pending(client_.ssl) > 0 ||
+                           SSL_has_pending(client_.ssl));
+  }
+
+  // LEGACY serve model only (reactor off): between keep-alive requests
+  // (and before the very first one) the owning worker waits at most the
+  // idle timeout for the next request head, so an idle client session
+  // cannot pin a bounded-pool worker for its connection's whole lifetime.
+  // Under the reactor this wait does not exist at all — the connection is
+  // parked in epoll and the idle bound is enforced by the reactor's
+  // deadline sweep at zero worker cost.
+  bool await_next_request() {
+    if (input_buffered()) return true;
     int timeout_ms = p_->idle_timeout_sec() * 1000;
     if (timeout_ms >= p_->cfg_.io_timeout_sec * 1000)
       return true;  // idle bound ≥ io timeout: SO_RCVTIMEO governs
@@ -411,26 +470,57 @@ class Session {
     }
   }
 
-  void run() {
-    RequestHead req;
-    client_.head_mode = true;  // see Conn::head_mode
-    if (!await_next_request()) return;
-    if (!parse_request_head(&client_, &req)) return;
-    client_.head_mode = false;
-    if (req.method == "CONNECT") {
-      handle_connect(req);
-    } else {
-      // absolute-form plain-HTTP proxying, or origin-form health endpoints
-      handle_plain(req);
+  // One serving step: parse + serve requests until the connection has no
+  // more received input, then ask to be parked (or closed). Called with
+  // input ready — the reactor dispatches on readability, the legacy worker
+  // loop awaits first — so the head parse's blocking reads only ever wait
+  // mid-request (SO_RCVTIMEO-governed), never between requests.
+  Disp step() {
+    if (state_ == State::kFresh) {
+      state_ = State::kPlain;
+      client_.head_mode = true;  // see Conn::head_mode
+      RequestHead req;
+      if (!parse_request_head(&client_, &req)) return Disp::kClose;
+      client_.head_mode = false;
+      if (req.method == "CONNECT") {
+        p_->metrics_.connects++;
+        const std::string authority = req.target;  // "host:port"
+        if (p_->should_mitm(authority)) {
+          p_->metrics_.mitm++;
+          if (!mitm_handshake(authority)) return Disp::kClose;
+          state_ = State::kMitm;
+          // the client may have pipelined its first TLS request into the
+          // handshake flight (SSL_has_pending) — serve it now, else park
+          if (!input_buffered()) return Disp::kPark;
+          return mitm_continue();
+        }
+        p_->metrics_.tunnel++;
+        // a blind tunnel is an opaque byte stream with no request
+        // boundaries to park between — it stays worker-held for life
+        blind_tunnel(authority);
+        return Disp::kClose;
+      }
+      return plain_continue(std::move(req));
     }
+    if (state_ == State::kMitm) return mitm_continue();
+    RequestHead req;
+    if (!parse_request_head(&client_, &req)) return Disp::kClose;
+    return plain_continue(std::move(req));
   }
 
  private:
+  enum class State { kFresh, kPlain, kMitm };
+
   Proxy *p_;
   Conn client_;
   Conn upstream_;
   std::string upstream_authority_;  // authority the upstream conn points at
   bool upstream_tls_ = false;
+  State state_ = State::kFresh;
+  // MITM target, held across parks (the CONNECT authority every decrypted
+  // request on this connection is served against)
+  std::string mitm_authority_, mitm_host_;
+  int mitm_port_ = 443;
 
   void log_request(const RequestHead &req, const std::string &uri) {
     if (!p_->cfg_.verbose) return;
@@ -456,23 +546,10 @@ class Session {
                "HTTP/1.1 %d %s\r\nContent-Length: %zu\r\n"
                "Content-Type: text/plain\r\nConnection: close\r\n\r\n",
                status, reason.c_str(), body.size());
-    return c->write_all(head, ::strlen(head)) &&
-           (body.empty() || c->write_all(body.data(), body.size()));
+    return c->writev_all(head, ::strlen(head), body.data(), body.size());
   }
 
   // ---------------------------------------------------------- CONNECT path
-  void handle_connect(const RequestHead &req) {
-    p_->metrics_.connects++;
-    const std::string &authority = req.target;  // "host:port"
-    if (p_->should_mitm(authority)) {
-      p_->metrics_.mitm++;
-      mitm_tunnel(authority);
-    } else {
-      p_->metrics_.tunnel++;
-      blind_tunnel(authority);
-    }
-  }
-
   void blind_tunnel(const std::string &authority) {
     std::string host, err;
     int port;
@@ -519,7 +596,10 @@ class Session {
     }
   }
 
-  void mitm_tunnel(const std::string &authority) {
+  // CONNECT + double handshake up to an established client TLS session —
+  // the point a MITM connection becomes parkable (the serve loop between
+  // requests is mitm_continue).
+  bool mitm_handshake(const std::string &authority) {
     std::string host;
     int port;
     split_authority(authority, &host, &port, 443);
@@ -531,10 +611,10 @@ class Session {
       ::fprintf(stderr, "[demodel-tpu] leaf mint failed for %s: %s\n", host.c_str(),
                 err.c_str());
       send_simple(&client_, 502, "Bad Gateway", "leaf mint failed");
-      return;
+      return false;
     }
     static const char ok[] = "HTTP/1.1 200 Connection Established\r\n\r\n";
-    if (!client_.write_all(ok, sizeof ok - 1)) return;
+    if (!client_.write_all(ok, sizeof ok - 1)) return false;
 
     SSL *ssl = SSL_new(ctx);
     SSL_set_fd(ssl, client_.fd);
@@ -543,158 +623,154 @@ class Session {
       ::fprintf(stderr, "[demodel-tpu] TLS accept from client failed (%s): %s\n",
                 host.c_str(), ssl_err_str().c_str());
       SSL_free(ssl);
-      return;
+      return false;
     }
     client_.ssl = ssl;
     client_.rbuf.clear();
     client_.rpos = 0;
+    mitm_authority_ = authority;
+    mitm_host_ = host;
+    mitm_port_ = port;
+    return true;
+  }
 
-    // serve decrypted requests until close
+  // Serve decrypted keep-alive requests while input is already received;
+  // park once the connection goes quiet. Entered with input ready (reactor
+  // dispatch / legacy await / SSL_has_pending after the handshake).
+  Disp mitm_continue() {
     for (;;) {
       RequestHead req;
-      if (!await_next_request()) return;
-      if (!parse_request_head(&client_, &req)) return;
-      if (!serve_one(req, "https", authority, host, port, /*tls=*/true)) return;
+      if (!parse_request_head(&client_, &req)) return Disp::kClose;
+      if (!serve_one(req, "https", mitm_authority_, mitm_host_, mitm_port_,
+                     /*tls=*/true))
+        return Disp::kClose;
       p_->maybe_gc();
-      std::string conn = lower(req.headers.get("connection"));
-      if (conn == "close") return;
+      if (lower(req.headers.get("connection")) == "close") return Disp::kClose;
+      if (!input_buffered()) return Disp::kPark;
     }
   }
 
   // ------------------------------------------------------- plain-HTTP path
-  // Loops over keep-alive requests (each may target a different host in
-  // absolute form); never recurses.
-  void handle_plain(RequestHead &req) {
+  // Serve `req` and further pipelined keep-alive requests (each may target
+  // a different host in absolute form) while input is already received;
+  // park once the connection goes quiet. Never recurses.
+  Disp plain_continue(RequestHead req) {
     for (;;) {
-      if (!req.target.empty() && req.target[0] == '/') {
-        // origin-form: observability + native peer-cache endpoints
-        // (peer shard exchange over DCN rides this data plane —
-        // SURVEY.md §2.3 "Cross-host / cross-pod peer cache")
-        if (req.target == "/healthz" || req.target == "/metrics") {
-          std::string body = p_->metrics_json();
-          char head[256];
-          ::snprintf(head, sizeof head,
-                     "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
-                     "Content-Length: %zu\r\nConnection: close\r\n\r\n",
-                     body.size());
-          client_.write_all(head, ::strlen(head));
-          client_.write_all(body.data(), body.size());
-          return;
-        }
-        if (req.target == "/peer/index" && p_->store_) {
-          // served from the store's generation-cached JSON — no directory
-          // scan per request (VERDICT r1 weak #6); auth-scoped objects are
-          // excluded at the source
-          std::string body = p_->store_->index_json();
-          char head[256];
-          ::snprintf(head, sizeof head,
-                     "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
-                     "Content-Length: %zu\r\nConnection: keep-alive\r\n\r\n",
-                     body.size());
-          if (!client_.write_all(head, ::strlen(head)) ||
-              !client_.write_all(body.data(), body.size()))
-            return;
-          // store-served bytes only: /peer/index is generated from the
-          // store, so it counts toward serve_bytes (the /healthz|/metrics
-          // handler above deliberately does NOT — a scraper polling an
-          // idle node must not fabricate serve traffic)
-          p_->metrics_.serve_bytes += body.size();
-          RequestHead next;
-          if (!await_next_request()) return;
-          if (!parse_request_head(&client_, &next)) return;
-          req = next;
-          continue;
-        }
-        if (req.target.rfind("/peer/meta/", 0) == 0 && p_->store_) {
-          std::string key = req.target.substr(11);
-          std::string meta = p_->store_->meta(key);
-          if (meta.empty() || p_->store_->is_private(key)) {
-            // auth-scoped objects are invisible to peers: serving them
-            // would launder a credentialed fetch to uncredentialed hosts
-            send_simple(&client_, 404, "Not Found", "no such object");
-            return;
-          }
-          char head[256];
-          ::snprintf(head, sizeof head,
-                     "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
-                     "Content-Length: %zu\r\nConnection: keep-alive\r\n\r\n",
-                     meta.size());
-          if (!client_.write_all(head, ::strlen(head)) ||
-              !client_.write_all(meta.data(), meta.size()))
-            return;
-          p_->metrics_.serve_bytes += meta.size();
-          RequestHead next;
-          if (!await_next_request()) return;
-          if (!parse_request_head(&client_, &next)) return;
-          req = next;
-          continue;
-        }
-        if (req.target.rfind("/peer/object/", 0) == 0 && p_->store_) {
-          std::string key = req.target.substr(13);
-          if (!p_->store_->has(key) || p_->store_->is_private(key)) {
-            send_simple(&client_, 404, "Not Found", "no such object");
-            return;
-          }
-          if (!serve_from_cache(req, req.target, key)) return;
-          RequestHead next;
-          if (!await_next_request()) return;
-          if (!parse_request_head(&client_, &next)) return;
-          req = next;
-          continue;
-        }
-        if (req.target.rfind("/restore/", 0) == 0 && p_->store_) {
-          // native restore data plane: /restore/{model}/tensor/{name}
-          // serves a registered tensor's byte window straight off the
-          // store fd (sendfile for plain clients) — the Python restore
-          // server stays the control plane that registered the mapping
-          auto tpos = req.target.find("/tensor/");
-          if (tpos != std::string::npos) {
-            std::string model = req.target.substr(9, tpos - 9);
-            std::string tensor = req.target.substr(tpos + 8);
-            TensorLoc loc;
-            if (!p_->lookup_tensor(model + "/" + tensor, &loc) ||
-                !p_->store_->has(loc.key)) {
-              send_simple(&client_, 404, "Not Found", "no such tensor");
-              return;
-            }
-            if (!serve_tensor_window(req, loc)) return;
-            RequestHead next;
-            if (!await_next_request()) return;
-            if (!parse_request_head(&client_, &next)) return;
-            req = next;
-            continue;
-          }
-        }
-        send_simple(&client_, 400, "Bad Request",
-                    "this is an HTTP proxy; use it via HTTP(S)_PROXY");
-        return;
-      }
-      if (req.target.rfind("http://", 0) != 0) {
-        send_simple(&client_, 400, "Bad Request", "unsupported target");
-        return;
-      }
-      // absolute-form: http://host[:port]/path
-      std::string rest = req.target.substr(7), hostport, path = "/";
-      auto slash = rest.find('/');
-      if (slash == std::string::npos) {
-        hostport = rest;
-      } else {
-        hostport = rest.substr(0, slash);
-        path = rest.substr(slash);
-      }
-      std::string host;
-      int port;
-      split_authority(hostport, &host, &port, 80);
-      std::string authority = host + ":" + std::to_string(port);
-      req.target = path;
-      if (!serve_one(req, "http", authority, host, port, /*tls=*/false)) return;
-      p_->maybe_gc();
-      if (lower(req.headers.get("connection")) == "close") return;
+      if (!plain_one(req)) return Disp::kClose;
+      if (!input_buffered()) return Disp::kPark;
       RequestHead next;
-      if (!await_next_request()) return;
-      if (!parse_request_head(&client_, &next)) return;
-      req = next;
+      if (!parse_request_head(&client_, &next)) return Disp::kClose;
+      req = std::move(next);
     }
+  }
+
+  // One plain-HTTP request. Returns false when the connection must close
+  // (response said so, transport died, or the request was unservable).
+  bool plain_one(RequestHead &req) {
+    if (!req.target.empty() && req.target[0] == '/') {
+      // origin-form: observability + native peer-cache endpoints
+      // (peer shard exchange over DCN rides this data plane —
+      // SURVEY.md §2.3 "Cross-host / cross-pod peer cache")
+      if (req.target == "/healthz" || req.target == "/metrics") {
+        std::string body = p_->metrics_json();
+        char head[256];
+        ::snprintf(head, sizeof head,
+                   "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                   "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                   body.size());
+        client_.writev_all(head, ::strlen(head), body.data(), body.size());
+        return false;
+      }
+      if (req.target == "/peer/index" && p_->store_) {
+        // served from the store's generation-cached JSON — no directory
+        // scan per request (VERDICT r1 weak #6); auth-scoped objects are
+        // excluded at the source
+        std::string body = p_->store_->index_json();
+        char head[256];
+        ::snprintf(head, sizeof head,
+                   "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                   "Content-Length: %zu\r\nConnection: keep-alive\r\n\r\n",
+                   body.size());
+        if (!client_.writev_all(head, ::strlen(head), body.data(), body.size()))
+          return false;
+        // store-served bytes only: /peer/index is generated from the
+        // store, so it counts toward serve_bytes (the /healthz|/metrics
+        // handler above deliberately does NOT — a scraper polling an
+        // idle node must not fabricate serve traffic)
+        p_->metrics_.serve_bytes += body.size();
+        return true;
+      }
+      if (req.target.rfind("/peer/meta/", 0) == 0 && p_->store_) {
+        std::string key = req.target.substr(11);
+        std::string meta = p_->store_->meta(key);
+        if (meta.empty() || p_->store_->is_private(key)) {
+          // auth-scoped objects are invisible to peers: serving them
+          // would launder a credentialed fetch to uncredentialed hosts
+          send_simple(&client_, 404, "Not Found", "no such object");
+          return false;
+        }
+        char head[256];
+        ::snprintf(head, sizeof head,
+                   "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                   "Content-Length: %zu\r\nConnection: keep-alive\r\n\r\n",
+                   meta.size());
+        if (!client_.writev_all(head, ::strlen(head), meta.data(), meta.size()))
+          return false;
+        p_->metrics_.serve_bytes += meta.size();
+        return true;
+      }
+      if (req.target.rfind("/peer/object/", 0) == 0 && p_->store_) {
+        std::string key = req.target.substr(13);
+        if (!p_->store_->has(key) || p_->store_->is_private(key)) {
+          send_simple(&client_, 404, "Not Found", "no such object");
+          return false;
+        }
+        return serve_from_cache(req, req.target, key);
+      }
+      if (req.target.rfind("/restore/", 0) == 0 && p_->store_) {
+        // native restore data plane: /restore/{model}/tensor/{name}
+        // serves a registered tensor's byte window straight off the
+        // store fd (sendfile for plain clients) — the Python restore
+        // server stays the control plane that registered the mapping
+        auto tpos = req.target.find("/tensor/");
+        if (tpos != std::string::npos) {
+          std::string model = req.target.substr(9, tpos - 9);
+          std::string tensor = req.target.substr(tpos + 8);
+          TensorLoc loc;
+          if (!p_->lookup_tensor(model + "/" + tensor, &loc) ||
+              !p_->store_->has(loc.key)) {
+            send_simple(&client_, 404, "Not Found", "no such tensor");
+            return false;
+          }
+          return serve_tensor_window(req, loc);
+        }
+      }
+      send_simple(&client_, 400, "Bad Request",
+                  "this is an HTTP proxy; use it via HTTP(S)_PROXY");
+      return false;
+    }
+    if (req.target.rfind("http://", 0) != 0) {
+      send_simple(&client_, 400, "Bad Request", "unsupported target");
+      return false;
+    }
+    // absolute-form: http://host[:port]/path
+    std::string rest = req.target.substr(7), hostport, path = "/";
+    auto slash = rest.find('/');
+    if (slash == std::string::npos) {
+      hostport = rest;
+    } else {
+      hostport = rest.substr(0, slash);
+      path = rest.substr(slash);
+    }
+    std::string host;
+    int port;
+    split_authority(hostport, &host, &port, 80);
+    std::string authority = host + ":" + std::to_string(port);
+    req.target = path;
+    if (!serve_one(req, "http", authority, host, port, /*tls=*/false))
+      return false;
+    p_->maybe_gc();
+    return lower(req.headers.get("connection")) != "close";
   }
 
   // ----------------------------------------------------------------- CORS
@@ -1741,9 +1817,11 @@ class Session {
       head += "Content-Length: " + std::to_string(size) +
               "\r\nX-Demodel-Cache: HIT\r\nConnection: keep-alive\r\n\r\n";
       log_response(req, uri, 401, ct, size, true);
-      if (!client_.write_all(head.data(), head.size())) return false;
-      if (req.method == "HEAD" || body.empty()) return true;
-      if (!client_.write_all(body.data(), body.size())) return false;
+      if (req.method == "HEAD" || body.empty())
+        return client_.write_all(head.data(), head.size());
+      if (!client_.writev_all(head.data(), head.size(), body.data(),
+                              body.size()))
+        return false;
       p_->metrics_.serve_bytes += body.size();
       return true;
     }
@@ -1780,6 +1858,30 @@ class Session {
       head += "Content-Range: bytes " + std::to_string(off) + "-" +
               std::to_string(off + len - 1) + "/" + std::to_string(size) + "\r\n";
     head += "Accept-Ranges: bytes\r\nX-Demodel-Cache: HIT\r\nConnection: keep-alive\r\n\r\n";
+
+    // small-object fast path: coalesce header+body into one vectored write
+    // — meta/config-sized blobs (and small ranges of big ones) leave as a
+    // single syscall/segment instead of a write(head)+sendfile pair
+    const int64_t kCoalesceMax = 256 << 10;
+    if (!client_.ssl && req.method != "HEAD" && len > 0 &&
+        len <= kCoalesceMax) {
+      std::vector<char> body(static_cast<size_t>(len));
+      int64_t got = 0;
+      while (got < len) {
+        int64_t n = p_->store_->pread(key, body.data() + got, len - got,
+                                      off + got);
+        if (n <= 0) return false;
+        got += n;
+      }
+      if (!client_.writev_all(head.data(), head.size(), body.data(),
+                              body.size()))
+        return false;
+      log_response(req, uri, status, ct, len, true);
+      p_->metrics_.bytes_cache += static_cast<uint64_t>(len);
+      p_->metrics_.serve_bytes += static_cast<uint64_t>(len);
+      return true;
+    }
+
     if (!client_.write_all(head.data(), head.size())) return false;
     log_response(req, uri, status, ct, len, true);
     if (req.method == "HEAD") return true;
@@ -2015,7 +2117,7 @@ static int available_cpus() {
 // Positive integer env value, or 0 when unset/malformed (degrade-not-crash:
 // a fat-fingered value falls back to the computed default, same policy as
 // the Python side's env_int).
-static int env_pos_int(const char *name) {
+static int env_pos_int(const char *name, int cap = 4096) {
   // NOLINTNEXTLINE(concurrency-mt-unsafe) — read-only env access; nothing
   // in this process calls setenv after startup (config is env-frozen by
   // the Python launcher before any native thread exists)
@@ -2028,7 +2130,17 @@ static int env_pos_int(const char *name) {
               "using default\n", name, v);
     return 0;
   }
-  return n > 4096 ? 4096 : static_cast<int>(n);
+  return n > cap ? cap : static_cast<int>(n);
+}
+
+// DEMODEL_PROXY_REACTOR: the event-driven serve plane's escape hatch —
+// only an explicit "0"/"false"/"off"/"no" disables the reactor.
+static bool env_reactor_on() {
+  // NOLINTNEXTLINE(concurrency-mt-unsafe) — read-only env access (above)
+  const char *v = ::getenv("DEMODEL_PROXY_REACTOR");
+  if (!v || !*v) return true;
+  std::string s = lower(v);
+  return s != "0" && s != "false" && s != "off" && s != "no";
 }
 
 std::string Proxy::metrics_json() {
@@ -2038,7 +2150,12 @@ std::string Proxy::metrics_json() {
       live_sessions_.load() > 0 ? live_sessions_.load() : 0);
   {
     std::lock_guard<Mutex> g(queue_mu_);
-    metrics_.sessions_queue_depth = accept_queue_.size();
+    metrics_.sessions_queue_depth = ready_.size();
+  }
+  {
+    // parked = in the epoll set + handed back but not yet re-armed
+    std::lock_guard<Mutex> g(reactor_mu_);
+    metrics_.sessions_parked = parked_.size() + inbox_.size();
   }
   return metrics_.json();
 }
@@ -2088,22 +2205,26 @@ void Proxy::reject_overflow(int cfd) {
   ::close(cfd);
 }
 
-// One pool worker: pop an accepted fd, run its whole session (including
-// keep-alive request cycles) on this reused stack, repeat. Exits when
-// stop() flips running_ and the queue is drained.
+// One pool worker: pop a ready session, serve it, repeat. Reactor mode:
+// serve exactly the received requests and hand the connection straight
+// back to the reactor — a worker never waits between requests, so pool
+// occupancy tracks ACTIVE requests, not open connections. Legacy mode:
+// the worker owns the connection's whole keep-alive lifetime (bounded by
+// the idle-timeout poll in await_next_request). Exits when stop() flips
+// running_ and the queue is drained.
 void Proxy::worker_loop() {
   for (;;) {
-    int cfd = -1;
+    Session *s = nullptr;
     {
       std::unique_lock<Mutex> lk(queue_mu_);
-      queue_cv_.wait(lk, [&] { return !running_ || !accept_queue_.empty(); });
-      if (!accept_queue_.empty()) {
-        cfd = accept_queue_.front();
-        accept_queue_.pop_front();
+      queue_cv_.wait(lk, [&] { return !running_ || !ready_.empty(); });
+      if (!ready_.empty()) {
+        s = ready_.front();
+        ready_.pop_front();
         // count the claim while still holding queue_mu_: stop() must not
-        // observe live_sessions_==0 between this pop and the Session
-        // registration, or it would skip the force-close wait and block
-        // in the worker join behind a session nothing ever unblocks
+        // observe live_sessions_==0 between this pop and the serve, or it
+        // would skip the force-close wait and block in the worker join
+        // behind a session nothing ever unblocks
         live_sessions_++;
       } else if (!running_) {
         return;
@@ -2111,11 +2232,21 @@ void Proxy::worker_loop() {
         continue;
       }
     }
-    {
-      Session s(this, cfd);
-      s.run();
+    if (reactor_enabled_) {
+      Session::Disp d = s->step();
+      live_sessions_--;
+      if (d == Session::Disp::kPark)
+        reactor_park(s);
+      else
+        delete s;
+    } else {
+      for (;;) {
+        if (!s->await_next_request()) break;
+        if (s->step() == Session::Disp::kClose) break;
+      }
+      delete s;
+      live_sessions_--;
     }
-    live_sessions_--;
   }
 }
 
@@ -2162,11 +2293,41 @@ int Proxy::start() {
                           ? cfg_.idle_timeout_sec
                           : env_pos_int("DEMODEL_PROXY_IDLE_TIMEOUT");
   if (idle_timeout_sec_ <= 0) idle_timeout_sec_ = 5;
+  // serve model: explicit config wins, then DEMODEL_PROXY_REACTOR (on by
+  // default); admission bound likewise (reactor conns are cheap — the
+  // bound exists so a SYN flood degrades into 503s, not fd exhaustion)
+  reactor_enabled_ = cfg_.reactor >= 0 ? cfg_.reactor != 0 : env_reactor_on();
+  max_conns_ = cfg_.max_conns > 0
+                   ? cfg_.max_conns
+                   : env_pos_int("DEMODEL_PROXY_MAX_CONNS", 65536);
+  if (max_conns_ <= 0) max_conns_ = 4096;
+
+  if (reactor_enabled_) {
+    epoll_fd_ = ::epoll_create1(EPOLL_CLOEXEC);
+    event_fd_ = ::eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    struct epoll_event ev = {};
+    ev.events = EPOLLIN;  // level-triggered: nullptr ptr marks the eventfd
+    ev.data.ptr = nullptr;
+    if (epoll_fd_ < 0 || event_fd_ < 0 ||
+        ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, event_fd_, &ev) != 0) {
+      // degrade to the legacy pool rather than refuse to serve
+      ::fprintf(stderr,
+                "[demodel-tpu] epoll reactor setup failed (%s); "
+                "falling back to worker-held connections\n",
+                ::strerror(errno));  // NOLINT(concurrency-mt-unsafe)
+      if (epoll_fd_ >= 0) ::close(epoll_fd_);
+      if (event_fd_ >= 0) ::close(event_fd_);
+      epoll_fd_ = event_fd_ = -1;
+      reactor_enabled_ = false;
+    }
+  }
 
   running_ = true;
   workers_.reserve(static_cast<size_t>(session_threads_));
   for (int i = 0; i < session_threads_; i++)
     workers_.emplace_back([this] { worker_loop(); });
+  if (reactor_enabled_)
+    reactor_thread_ = std::thread([this] { reactor_loop(); });
 
   accept_thread_ = std::thread([this] {
     while (running_) {
@@ -2180,15 +2341,27 @@ int Proxy::start() {
       ::setsockopt(cfd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
       int one2 = 1;
       ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof one2);
-      bool queued = false;
+      if (conn_count_.load() >= max_conns_) {
+        // admission bound: the overflow contract at reactor scale
+        reject_overflow(cfd);
+        continue;
+      }
+      if (reactor_enabled_) {
+        // park the fresh connection until its first bytes arrive — an
+        // idle flood costs the pool nothing and a worker is only woken
+        // for a connection that can make progress
+        reactor_park(new Session(this, cfd));
+        continue;
+      }
+      Session *s = nullptr;
       {
         std::lock_guard<Mutex> g(queue_mu_);
-        if (accept_queue_.size() < session_queue_cap_) {
-          accept_queue_.push_back(cfd);
-          queued = true;
+        if (ready_.size() < session_queue_cap_) {
+          s = new Session(this, cfd);
+          ready_.push_back(s);
         }
       }
-      if (queued)
+      if (s != nullptr)
         queue_cv_.notify_one();
       else
         reject_overflow(cfd);
@@ -2208,15 +2381,20 @@ void Proxy::stop() {
     ::close(fd);
     listen_fd_ = -1;
   }
+  // the reactor drains: it observes running_==false on the eventfd wake
+  // and deletes every parked/inbox session on its way out (their fds
+  // close with the Session destructors) — parked conns carry no in-flight
+  // request, so closing IS the drain
+  if (reactor_thread_.joinable()) {
+    wake_reactor();
+    reactor_thread_.join();
+  }
   // queued-but-unserved connections are closed, not served: shutdown
   // truncates the backlog the same way the kernel drops its SYN backlog
   {
     std::lock_guard<Mutex> g(queue_mu_);
-    for (int qfd : accept_queue_) {
-      ::shutdown(qfd, SHUT_RDWR);
-      ::close(qfd);
-    }
-    accept_queue_.clear();
+    for (Session *s : ready_) delete s;
+    ready_.clear();
   }
   queue_cv_.notify_all();
   // force live sessions' blocking IO to fail, then wait for ALL of them —
@@ -2236,6 +2414,174 @@ void Proxy::stop() {
   for (auto &w : workers_)
     if (w.joinable()) w.join();
   workers_.clear();
+  if (epoll_fd_ >= 0) {
+    ::close(epoll_fd_);
+    epoll_fd_ = -1;
+  }
+  if (event_fd_ >= 0) {
+    ::close(event_fd_);
+    event_fd_ = -1;
+  }
+}
+
+// ---------------------------------------------------------------- reactor
+// The serve plane's event loop: every accepted connection lives here
+// whenever it has no active request. Edge-triggered oneshot EPOLLIN means
+// one dispatch per readability transition and no event can fire while a
+// worker owns the fd; the eventfd (data.ptr == nullptr) wakes the loop for
+// inbox arrivals and stop(). Idle enforcement is a deadline sweep over a
+// FIFO of (session, deadline) — deadlines are arm-time + a constant, so
+// the queue is naturally sorted and the sweep is O(expired), not O(parked).
+
+void Proxy::wake_reactor() {
+  uint64_t one = 1;
+  (void)!::write(event_fd_, &one, sizeof one);
+}
+
+// Hand a connection (back) to the reactor. Outside the reactor thread the
+// epoll set is never touched — the inbox + eventfd funnel every (re-)arm
+// through the loop, so oneshot re-arms cannot race a concurrent dispatch.
+void Proxy::reactor_park(Session *s) {
+  bool queued = false;
+  {
+    std::lock_guard<Mutex> g(reactor_mu_);
+    if (running_) {
+      inbox_.push_back(s);
+      queued = true;
+    }
+  }
+  if (queued)
+    wake_reactor();
+  else
+    delete s;  // stopping: the connection closes instead of parking
+}
+
+void Proxy::reactor_loop() {
+  // park deadline: the keep-alive idle bound, capped by io_timeout (a
+  // parked conn has no read in flight, so SO_RCVTIMEO cannot govern it
+  // the way it did when a worker owned the idle wait)
+  const auto idle_span = std::chrono::seconds(
+      std::min(idle_timeout_sec_, cfg_.io_timeout_sec));
+  // (session, deadline) in arm order — deadline order by construction
+  std::deque<std::pair<Session *, std::chrono::steady_clock::time_point>>
+      expiry;
+  std::vector<struct epoll_event> evs(256);
+  std::vector<Session *> ready;
+  for (;;) {
+    int timeout_ms = -1;
+    {
+      std::lock_guard<Mutex> g(reactor_mu_);
+      while (!expiry.empty()) {
+        auto it = parked_.find(expiry.front().first);
+        // stale entries (dispatched, re-parked with a newer deadline, or
+        // long gone) are dropped lazily here
+        if (it == parked_.end() || it->second != expiry.front().second) {
+          expiry.pop_front();
+          continue;
+        }
+        auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      expiry.front().second -
+                      std::chrono::steady_clock::now())
+                      .count();
+        timeout_ms = ms <= 0 ? 0 : static_cast<int>(std::min<long long>(
+                                       ms + 1, 60 * 1000));
+        break;
+      }
+    }
+    int n = ::epoll_wait(epoll_fd_, evs.data(), static_cast<int>(evs.size()),
+                         timeout_ms);
+    if (!running_) break;
+    metrics_.reactor_wakeups++;
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;  // epoll fd itself failed: nothing sane left to do
+    }
+    // 1) readiness: move fired sessions out of the parked set (their
+    // oneshot arm is already spent) and batch them for the worker pool
+    ready.clear();
+    for (int i = 0; i < n; i++) {
+      if (evs[i].data.ptr == nullptr) {
+        uint64_t junk;
+        while (::read(event_fd_, &junk, sizeof junk) > 0) {
+        }
+        continue;
+      }
+      auto *s = static_cast<Session *>(evs[i].data.ptr);
+      std::lock_guard<Mutex> g(reactor_mu_);
+      if (parked_.erase(s) > 0) ready.push_back(s);
+    }
+    // 2) arm inbox arrivals (first park ADDs, re-park MODs the spent
+    // oneshot); epoll reports readiness at arm time, so bytes that landed
+    // before the arm still fire — nothing is lost in the handoff window
+    std::deque<Session *> in;
+    {
+      std::lock_guard<Mutex> g(reactor_mu_);
+      in.swap(inbox_);
+    }
+    auto now = std::chrono::steady_clock::now();
+    for (Session *s : in) {
+      struct epoll_event ev = {};
+      ev.events = EPOLLIN | EPOLLRDHUP | EPOLLET | EPOLLONESHOT;
+      ev.data.ptr = s;
+      if (::epoll_ctl(epoll_fd_, s->epoll_armed ? EPOLL_CTL_MOD : EPOLL_CTL_ADD,
+                      s->client_fd(), &ev) != 0) {
+        metrics_.errors++;
+        delete s;
+        continue;
+      }
+      s->epoll_armed = true;
+      auto deadline = now + idle_span;
+      {
+        std::lock_guard<Mutex> g(reactor_mu_);
+        parked_[s] = deadline;
+      }
+      expiry.emplace_back(s, deadline);
+    }
+    // 3) idle sweep: close parked conns past their deadline
+    now = std::chrono::steady_clock::now();
+    for (;;) {
+      Session *victim = nullptr;
+      {
+        std::lock_guard<Mutex> g(reactor_mu_);
+        while (!expiry.empty()) {
+          auto &front = expiry.front();
+          auto it = parked_.find(front.first);
+          if (it == parked_.end() || it->second != front.second) {
+            expiry.pop_front();  // stale (see above)
+            continue;
+          }
+          if (front.second > now) break;
+          victim = front.first;
+          parked_.erase(it);
+          expiry.pop_front();
+          break;
+        }
+      }
+      if (victim == nullptr) break;
+      metrics_.sessions_idle_closed++;
+      delete victim;  // destructor closes the fd → kernel drops it from epoll
+    }
+    // 4) dispatch the ready batch to the pool
+    if (!ready.empty()) {
+      {
+        std::lock_guard<Mutex> g(queue_mu_);
+        for (Session *s : ready) ready_.push_back(s);
+      }
+      if (ready.size() == 1)
+        queue_cv_.notify_one();
+      else
+        queue_cv_.notify_all();
+    }
+  }
+  // teardown: every connection still owned by the reactor closes here
+  std::deque<Session *> leftovers;
+  {
+    std::lock_guard<Mutex> g(reactor_mu_);
+    leftovers.swap(inbox_);
+    for (auto &p : parked_) leftovers.push_back(p.first);
+    parked_.clear();
+  }
+  for (Session *s : leftovers) delete s;
 }
 
 // ---------------------------------------------------------- peer fetch
@@ -2655,7 +3001,7 @@ void *dm_proxy_new(const char *host, int port, int mitm_all, int no_mitm,
                    int64_t cache_max_mb, int ranged_fill,
                    int64_t fill_max_mb, int fill_min_pct,
                    int challenge_ttl_sec, int session_threads,
-                   int session_queue) {
+                   int session_queue, int reactor, int max_conns) {
   dm::ProxyConfig cfg;
   cfg.host = host ? host : "127.0.0.1";
   cfg.port = port;
@@ -2686,6 +3032,8 @@ void *dm_proxy_new(const char *host, int port, int mitm_all, int no_mitm,
   if (challenge_ttl_sec >= 0) cfg.challenge_ttl_sec = challenge_ttl_sec;
   if (session_threads > 0) cfg.session_threads = session_threads;
   if (session_queue > 0) cfg.session_queue = session_queue;
+  cfg.reactor = reactor;  // -1 auto (env), 0 legacy pool, 1 reactor
+  if (max_conns > 0) cfg.max_conns = max_conns;
   return new dm::Proxy(std::move(cfg));
 }
 

@@ -12,6 +12,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
@@ -77,6 +78,20 @@ struct ProxyConfig {
   // 0 = auto: env DEMODEL_PROXY_IDLE_TIMEOUT, else 5. Values ≥ io_timeout
   // effectively restore the old pin-until-io-timeout behavior.
   int idle_timeout_sec = 0;
+  // Event-driven serve plane (the C10k rebuild): a reactor thread owns
+  // every accepted connection and parks idle / keep-alive ones in epoll at
+  // zero worker cost — pool workers only ever hold connections with an
+  // ACTIVE request, handing the fd back to the reactor between requests.
+  // -1 = auto: env DEMODEL_PROXY_REACTOR ("0"/"false"/"off"/"no" disables),
+  // default ON. 0/1 force. With the reactor off, the pre-reactor model
+  // (worker owns the connection's whole keep-alive lifetime) applies.
+  int reactor = -1;
+  // Connection-admission bound. Under the reactor a small pool serves
+  // thousands of parked connections, so the 503+Retry-After overflow
+  // contract moves from queue depth to total live connections: beyond
+  // this many, accept answers 503 on the spot. 0 = auto: env
+  // DEMODEL_PROXY_MAX_CONNS, else 4096. Applies in both serve models.
+  int max_conns = 0;
 };
 
 struct Metrics {
@@ -91,8 +106,12 @@ struct Metrics {
   // sessions_idle_closed counts keep-alive connections the idle timeout
   // released back to the pool (a high rate with a saturated pool means
   // clients hold connections open without using them).
+  // sessions_parked is a gauge: connections the reactor currently holds in
+  // epoll with no worker attached (idle keep-alive); reactor_wakeups is a
+  // counter of epoll_wait returns — the event-loop heartbeat.
   std::atomic<uint64_t> sessions_active{0}, sessions_queue_depth{0},
-      sessions_rejected{0}, serve_bytes{0}, sessions_idle_closed{0};
+      sessions_rejected{0}, serve_bytes{0}, sessions_idle_closed{0},
+      sessions_parked{0}, reactor_wakeups{0};
   std::string json() const;
 };
 
@@ -135,15 +154,17 @@ class Proxy {
   Proxy(const Proxy &) = delete;
   Proxy &operator=(const Proxy &) = delete;
 
-  int start();  // bind+listen, accept thread + session worker pool; 0 or -errno
-  void stop();  // joins accept thread + workers, force-closes live sessions
+  int start();  // bind+listen, accept + reactor threads + worker pool; 0 or -errno
+  void stop();  // joins accept/reactor/workers, force-closes live sessions
   int port() const { return port_; }
   Metrics &metrics() { return metrics_; }
-  // metrics JSON with the pool gauges (sessions_active/queue_depth)
+  // metrics JSON with the pool gauges (sessions_active/queue_depth/parked)
   // refreshed from live state — what /metrics and dm_proxy_metrics serve
   std::string metrics_json();
   int session_threads() const { return session_threads_; }
   int idle_timeout_sec() const { return idle_timeout_sec_; }
+  bool reactor_enabled() const { return reactor_enabled_; }
+  int max_conns() const { return max_conns_; }
 
   bool should_mitm(const std::string &authority) const;
   SSL_CTX *leaf_ctx(const std::string &host, std::string *err);
@@ -204,19 +225,43 @@ class Proxy {
   std::thread accept_thread_;
   std::atomic<uint64_t> gc_tick_{0};
 
-  // bounded session executor: accept thread pushes client fds, the fixed
-  // worker pool pops them; overflow never reaches the queue (503'd on the
-  // accept thread). queue_mu_ is rank-checked like every other member
-  // mutex (condition_variable_any works over the ranked mutex).
+  // bounded session executor: the ready queue feeds the fixed worker pool.
+  // Reactor mode: the reactor pushes sessions whose fd went readable (and
+  // the accept thread parks fresh conns straight into the reactor), so the
+  // queue holds only work that can make progress — its depth is bounded by
+  // max_conns_, and admission overflow is 503'd at accept. Legacy mode
+  // (reactor off): the accept thread pushes fresh sessions directly and
+  // queue overflow beyond session_queue_cap_ is 503'd, as before.
+  // queue_mu_ is rank-checked like every other member mutex
+  // (condition_variable_any works over the ranked mutex).
   void worker_loop();
   void reject_overflow(int cfd);
   Mutex queue_mu_{kRankProxyQueue};
   std::condition_variable_any queue_cv_;
-  std::deque<int> accept_queue_;
+  std::deque<Session *> ready_;
   std::vector<std::thread> workers_;
   int session_threads_ = 0;   // resolved pool size (start())
   size_t session_queue_cap_ = 0;
   int idle_timeout_sec_ = 5;  // resolved keep-alive idle bound (start())
+
+  // epoll reactor: parks idle keep-alive connections at zero worker cost.
+  // parked_ (session → idle deadline) is the authoritative parked set;
+  // inbox_ holds sessions workers/accept handed back, awaiting (re-)arm by
+  // the reactor thread (eventfd-woken). Both under reactor_mu_ — ranked
+  // BELOW queue_mu_ (the reactor never holds reactor_mu_ across a queue
+  // push, but the rank order documents the one legal nesting direction).
+  void reactor_loop();
+  void reactor_park(Session *s);
+  void wake_reactor();
+  Mutex reactor_mu_{kRankProxyReactor};
+  std::unordered_map<Session *, std::chrono::steady_clock::time_point> parked_;
+  std::deque<Session *> inbox_;
+  std::thread reactor_thread_;
+  int epoll_fd_ = -1;
+  int event_fd_ = -1;
+  bool reactor_enabled_ = false;  // resolved serve model (start())
+  int max_conns_ = 0;             // resolved admission bound (start())
+  std::atomic<int> conn_count_{0};  // live Session objects (all states)
 };
 
 }  // namespace dm

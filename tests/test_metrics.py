@@ -64,15 +64,19 @@ def test_render_types_pool_gauges_as_gauges():
         def metrics(self):
             return {"requests": 7, "sessions_active": 2,
                     "sessions_queue_depth": 1, "sessions_rejected_total": 3,
-                    "serve_bytes_total": 4096}
+                    "serve_bytes_total": 4096, "sessions_parked": 9,
+                    "reactor_wakeups_total": 40}
 
     body = m.render(proxy=FakeProxy())
     assert "# TYPE demodel_proxy_sessions_active gauge" in body
     assert "# TYPE demodel_proxy_sessions_queue_depth gauge" in body
+    assert "# TYPE demodel_proxy_sessions_parked gauge" in body
     assert "# TYPE demodel_proxy_sessions_rejected_total counter" in body
     assert "# TYPE demodel_proxy_serve_bytes_total counter" in body
+    assert "# TYPE demodel_proxy_reactor_wakeups_total counter" in body
     assert "# TYPE demodel_proxy_requests counter" in body
     assert "demodel_proxy_serve_bytes_total 4096" in body
+    assert "demodel_proxy_sessions_parked 9" in body
 
 
 def test_labeled_counters_and_gauges_typed_correctly():
@@ -202,8 +206,11 @@ def test_serve_counters_move_under_load(tmp_path):
 def test_pool_overflow_rejects_cleanly(tmp_path):
     """With a 1-worker/1-slot executor, saturating connections get queued
     and the overflow is answered 503 + Retry-After (counted, never silently
-    dropped)."""
-    node = _node(tmp_path, "flood", session_threads=1, session_queue=1)
+    dropped). LEGACY serve model on purpose: idle connections only pin
+    workers (and thus saturate the queue) with the reactor off — the
+    reactor-era overflow contract is test_reactor_max_conns_503 below."""
+    node = _node(tmp_path, "flood", session_threads=1, session_queue=1,
+                 reactor=False)
     _warm(node, "floodobj00000001", b"f" * 1024)
     node.start()
     idle = []
@@ -235,9 +242,11 @@ def test_pool_overflow_rejects_cleanly(tmp_path):
 
 def test_explicit_pool_size_beats_env(tmp_path, monkeypatch):
     """Same convention as _peer_streams(): an explicit value wins over the
-    env, the env wins over the affinity default."""
+    env, the env wins over the affinity default. Legacy model: the witness
+    is idle conns pinning workers, which the reactor prevents."""
     monkeypatch.setenv("DEMODEL_PROXY_THREADS", "3")
-    node = _node(tmp_path, "env", session_threads=2, session_queue=1)
+    node = _node(tmp_path, "env", session_threads=2, session_queue=1,
+                 reactor=False)
     node.start()
     idle = []
     try:
@@ -266,6 +275,103 @@ def test_explicit_pool_size_beats_env(tmp_path, monkeypatch):
     finally:
         for s in idle:
             s.close()
+        node.stop()
+
+
+# -------------------------------------------------- event-driven serve plane
+
+# one keep-alive HTTP framing helper for the whole repo's raw-socket
+# drives — the serve bench owns it
+from tools.bench_serve import _ka_get  # noqa: E402
+
+
+def _keepalive_get(sock: socket.socket, path: str) -> bytes:
+    status, body, head = _ka_get(sock, path)
+    assert status == 200, head[:80]
+    return body
+
+
+def test_reactor_parks_idle_keepalive_conns(tmp_path, monkeypatch):
+    """The C10k contract in miniature: N keep-alive connections through a
+    ONE-worker pool are all served (only possible when idle conns park at
+    zero worker cost), the parked gauge tracks them, and a parked conn
+    resumes on its next request. The idle bound is pinned high so the
+    reactor's deadline sweep cannot FIN the held conns mid-test on a slow
+    CI host (same reason the C++ selftests pin idle_timeout_sec=30)."""
+    monkeypatch.setenv("DEMODEL_PROXY_IDLE_TIMEOUT", "300")
+    node = _node(tmp_path, "react", session_threads=1)
+    _warm(node, "reactobj00000001", b"r" * 4096)
+    node.start()
+    conns: list[socket.socket] = []
+    try:
+        assert node.metrics()["sessions_parked"] == 0
+        for _ in range(6):
+            s = socket.create_connection(("127.0.0.1", node.port), timeout=10)
+            conns.append(s)
+            body = _keepalive_get(s, "/peer/object/reactobj00000001")
+            assert body == b"r" * 4096
+        deadline = time.monotonic() + 10
+        parked = 0
+        while time.monotonic() < deadline:
+            parked = node.metrics()["sessions_parked"]
+            if parked == 6:
+                break
+            time.sleep(0.05)
+        assert parked == 6, node.metrics()
+        assert node.metrics()["sessions_active"] == 0  # parked ≠ worker-held
+        assert node.metrics()["reactor_wakeups_total"] > 0
+        # resume a parked connection (oneshot re-arm path)
+        assert _keepalive_get(conns[2], "/peer/meta/reactobj00000001")
+    finally:
+        for s in conns:
+            s.close()
+        node.stop()
+
+
+def test_reactor_max_conns_503(tmp_path, monkeypatch):
+    """The overflow contract at reactor scale: admission beyond max_conns
+    is answered 503 + Retry-After on the spot — never silently dropped.
+    Idle bound pinned high: a swept held conn would free an admission
+    slot and hand the probe a 200."""
+    monkeypatch.setenv("DEMODEL_PROXY_IDLE_TIMEOUT", "300")
+    node = _node(tmp_path, "maxconn", session_threads=1, max_conns=3)
+    _warm(node, "maxconnobj000001", b"m" * 512)
+    node.start()
+    held = []
+    try:
+        for _ in range(3):
+            s = socket.create_connection(("127.0.0.1", node.port), timeout=10)
+            held.append(s)
+            _keepalive_get(s, "/peer/object/maxconnobj000001")
+        status, headers, body = _get(node.port, "/peer/object/maxconnobj000001")
+        assert status == 503
+        assert headers.get("Retry-After") == "1"
+        assert node.metrics()["sessions_rejected_total"] >= 1
+    finally:
+        for s in held:
+            s.close()
+        node.stop()
+
+
+def test_reactor_idle_close_counts_and_fins(tmp_path):
+    """The keep-alive idle bound survives the reactor rebuild: a parked
+    conn past DEMODEL_PROXY_IDLE_TIMEOUT gets a clean FIN and counts in
+    sessions_idle_closed_total — same semantics, now at zero worker cost."""
+    node = _node(tmp_path, "idle", session_threads=1, io_timeout_sec=30)
+    _warm(node, "idleobj000000001", b"i" * 256)
+    import os
+    os.environ["DEMODEL_PROXY_IDLE_TIMEOUT"] = "1"
+    try:
+        node.start()
+    finally:
+        del os.environ["DEMODEL_PROXY_IDLE_TIMEOUT"]
+    s = socket.create_connection(("127.0.0.1", node.port), timeout=15)
+    try:
+        _keepalive_get(s, "/peer/object/idleobj000000001")
+        assert s.recv(4096) == b""  # FIN within the 15 s socket timeout
+        assert node.metrics()["sessions_idle_closed_total"] >= 1
+    finally:
+        s.close()
         node.stop()
 
 

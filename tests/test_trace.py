@@ -44,6 +44,7 @@ NOOP_BUDGET_SECS = 5e-6
 def _fresh_trace_state(monkeypatch):
     monkeypatch.delenv("DEMODEL_TRACE", raising=False)
     monkeypatch.delenv("DEMODEL_TRACE_BUFFER", raising=False)
+    monkeypatch.delenv("DEMODEL_TRACE_SAMPLE", raising=False)
     trace.reset()
     m.HUB.reset()
     PeerHealth.reset_shared()
@@ -267,6 +268,164 @@ def test_jsonl_sink_writes_parseable_lines(tmp_path, monkeypatch):
     recs = [json.loads(ln) for ln in lines]
     assert [r["name"] for r in recs] == ["b", "a"]
     assert recs[0]["trace"] == recs[1]["trace"]
+
+
+# ------------------------------------------ head sampling (serve traffic)
+
+
+def test_sample_zero_drops_whole_traces(monkeypatch):
+    """DEMODEL_TRACE_SAMPLE=0: a new root is dropped and its descendants
+    are suppressed WITH it — never re-rolled into orphan fragments."""
+    monkeypatch.setenv("DEMODEL_TRACE_SAMPLE", "0")
+    trace.enable()
+    with trace.span("root") as root:
+        assert not isinstance(root, trace.Span)
+        with trace.span("child") as child:
+            assert child is trace.NOOP
+    assert _records() == []
+
+
+def test_sample_one_records_everything(monkeypatch):
+    monkeypatch.setenv("DEMODEL_TRACE_SAMPLE", "1.0")
+    trace.enable()
+    with trace.span("root"):
+        with trace.span("child"):
+            pass
+    assert {r["name"] for r in _records()} == {"root", "child"}
+
+
+def test_sample_decision_is_per_root(monkeypatch):
+    """The dice roll happens once per ROOT span; children inherit the
+    keep/drop decision from the ambient context."""
+    monkeypatch.setenv("DEMODEL_TRACE_SAMPLE", "0.5")
+    trace.enable()
+    rolls = iter([0.2, 0.9, 0.2])  # keep, drop, keep (rate 0.5)
+    monkeypatch.setattr(trace.random, "random", lambda: next(rolls))
+    with trace.span("kept"):
+        pass
+    with trace.span("dropped"):
+        with trace.span("dropped-child"):
+            pass
+    with trace.span("kept2"):
+        pass
+    assert [r["name"] for r in _records()] == ["kept", "kept2"]
+
+
+def test_remote_parented_span_bypasses_sampling(monkeypatch):
+    """A traceparent from the wire means the CALLING host already made the
+    keep decision — the serving side must not drop its half of the trace."""
+    monkeypatch.setenv("DEMODEL_TRACE_SAMPLE", "0")
+    trace.enable()
+    tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    with trace.span("serve", remote_parent=tp):
+        pass
+    (rec,) = _by_name("serve")
+    assert rec["trace"] == "ab" * 16
+
+
+def test_unsampled_root_crosses_wrap(monkeypatch):
+    """A dropped trace's thread fan-out must not re-roll per task: wrap()
+    carries the unsampled mark across the executor boundary."""
+    monkeypatch.setenv("DEMODEL_TRACE_SAMPLE", "0")
+    trace.enable()
+    out = []
+    with trace.span("root"):
+        fn = trace.wrap(lambda: out.append(trace.span("task")))
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        ex.submit(fn).result()
+    assert out[0] is trace.NOOP
+    assert _records() == []
+
+
+def test_malformed_sample_rate_records_everything(monkeypatch):
+    monkeypatch.setenv("DEMODEL_TRACE_SAMPLE", "lots")
+    trace.enable()
+    with trace.span("root"):
+        pass
+    assert _by_name("root")
+
+
+# ----------------------------------------------------- streaming-sink spans
+
+
+def _sink_with_fake_delivery(monkeypatch, delivered):
+    from demodel_tpu.sink import streaming as st_mod
+    from demodel_tpu.sink.hbm import Placement
+
+    def fake_deliver(store, name, key, mesh, plan, cast_to, buffer=None,
+                     ici_complete=None):
+        delivered.append(name)
+        return Placement(mesh_desc="fake")
+
+    monkeypatch.setattr(st_mod, "deliver_file", fake_deliver)
+    return st_mod.StreamingSink(store=None, overlap=True)
+
+
+def test_streaming_sink_deliver_span_stitches_to_submitter(monkeypatch):
+    """sink-deliver runs on the sink's worker thread; the submit site's
+    ambient span must reach it as its trace parent (carried across the
+    queue as a traceparent), so pull traces show where HBM time went."""
+    trace.enable()
+    delivered: list[str] = []
+    sink = _sink_with_fake_delivery(monkeypatch, delivered)
+
+    class Art:
+        name = "model-00001-of-00002.safetensors"
+        key = "k" * 16
+        media_type = ""
+
+    with trace.span("pull-root") as root:
+        sink.submit(Art())
+        root_trace = root.trace_id
+    sink.finish(block=False)
+    assert delivered == [Art.name]
+    (rec,) = _by_name("sink-deliver")
+    assert rec["trace"] == root_trace
+    assert rec["attrs"]["file"] == Art.name
+    assert rec["attrs"]["tensors"] == 0  # fake placement carries none
+
+
+def test_streaming_sink_respects_head_sampling(monkeypatch):
+    """A sampled-OUT pull must not leak orphan sink-deliver roots from the
+    worker side of the queue: the suppression verdict crosses with the
+    item (contextvars cannot follow it there)."""
+    monkeypatch.setenv("DEMODEL_TRACE_SAMPLE", "0")
+    trace.enable()
+    delivered: list[str] = []
+    sink = _sink_with_fake_delivery(monkeypatch, delivered)
+
+    class Art:
+        name = "model.safetensors"
+        key = "k" * 16
+        media_type = ""
+
+    with trace.span("pull-root"):  # unsampled root (rate 0)
+        sink.submit(Art())
+    sink.finish(block=False)
+    assert delivered == [Art.name]  # delivery itself still happened
+    assert _records() == []
+
+
+def test_streaming_sink_budget_wait_span(monkeypatch):
+    """A standalone producer charging the byte budget at submit() gets a
+    sink-budget-wait span — the stall the budget can introduce is visible
+    in the trace, not silent."""
+    import numpy as np_mod
+
+    trace.enable()
+    delivered: list[str] = []
+    sink = _sink_with_fake_delivery(monkeypatch, delivered)
+
+    class Art:
+        name = "model.safetensors"
+        key = "k" * 16
+        media_type = ""
+        buffer = np_mod.zeros(64, dtype=np_mod.uint8)
+
+    sink.submit(Art())
+    sink.finish(block=False)
+    (rec,) = _by_name("sink-budget-wait")
+    assert rec["attrs"] == {"file": Art.name, "bytes": 64}
 
 
 # --------------------------------------------- wire round-trip (dep-light)
