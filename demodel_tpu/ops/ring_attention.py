@@ -161,25 +161,52 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "sp",
                            causal: bool = True) -> jax.Array:
     """Global-view wrapper: shards the sequence over ``axis`` (padding to a
-    multiple of the ring size, masked), runs the ring, unpads."""
+    multiple of the ring size, masked), runs the ring, unpads.
+
+    Eager calls (concrete arrays — serving / tests, not under an outer
+    ``jit``) run under a ``compute.ring-attention`` span when EXPORT
+    tracing is opted in (``DEMODEL_TRACE`` / ``trace.enable()``), so the
+    compute plane shows up in the critical-path report and the stage
+    histograms alongside pull/serve/restore. The span syncs the result
+    (a dispatch-only duration would be a lie), so it deliberately does
+    NOT run under the default observe tier — default-config callers keep
+    fully async dispatch. ``jit``-traced calls skip the span entirely (a
+    span inside ``jit`` would record trace-time once, not run time)."""
     n = int(mesh.shape[axis])
     B, T, H, D = q.shape
-    pad = (-T) % n
-    kv_len = None
-    if pad:
-        kv_len = jnp.int32(T)
-        zq = ((0, 0), (0, pad), (0, 0), (0, 0))
-        q = jnp.pad(q, zq)
-        k = jnp.pad(k, zq)
-        v = jnp.pad(v, zq)
 
-    spec = P(None, axis, None, None)
-    from demodel_tpu.parallel.collectives import shard_map_nocheck
+    def run() -> jax.Array:
+        nonlocal q, k, v
+        pad = (-T) % n
+        kv_len = None
+        if pad:
+            kv_len = jnp.int32(T)
+            zq = ((0, 0), (0, pad), (0, 0), (0, 0))
+            q = jnp.pad(q, zq)
+            k = jnp.pad(k, zq)
+            v = jnp.pad(v, zq)
 
-    fn = shard_map_nocheck(
-        functools.partial(ring_attention, axis_name=axis, causal=causal,
-                          kv_len=kv_len),
-        mesh, (spec, spec, spec), spec,
-    )
-    out = fn(q, k, v)
-    return out[:, :T] if pad else out
+        spec = P(None, axis, None, None)
+        from demodel_tpu.parallel.collectives import shard_map_nocheck
+
+        fn = shard_map_nocheck(
+            functools.partial(ring_attention, axis_name=axis, causal=causal,
+                              kv_len=kv_len),
+            mesh, (spec, spec, spec), spec,
+        )
+        out = fn(q, k, v)
+        return out[:, :T] if pad else out
+
+    from demodel_tpu.utils import trace
+
+    if isinstance(q, jax.core.Tracer) or not trace.enabled():
+        return run()
+    with trace.span("compute.ring-attention", batch=B, tokens=T, heads=H,
+                    head_dim=D, ring=n, causal=causal):
+        out = run()
+        # demodel: allow(no-host-sync-in-hot-path) — observability-only
+        # sync: the span must time the COMPUTE, not the async dispatch;
+        # this branch runs only when the operator opted into export
+        # tracing, never on the default (observe-tier) hot path
+        jax.block_until_ready(out)
+        return out

@@ -62,11 +62,30 @@ def pipeline_apply(stage_fn, stages, xmb, mesh: Mesh | None = None,
         out, _ = lax.scan(body, x, stages)
         return out
 
-    # microbatches are independent given the stage weights: vmap expresses
-    # the pipeline's width; XLA overlaps stage compute across microbatches
-    # in the scheduled program (the GPipe bubble shows up as the dependency
-    # depth, not as Python control flow)
-    return jax.vmap(one_micro)(xmb)
+    def run():
+        # microbatches are independent given the stage weights: vmap
+        # expresses the pipeline's width; XLA overlaps stage compute across
+        # microbatches in the scheduled program (the GPipe bubble shows up
+        # as the dependency depth, not as Python control flow)
+        return jax.vmap(one_micro)(xmb)
+
+    # eager calls run under a compute span when EXPORT tracing is opted
+    # in (jit-traced calls always skip it — a span inside jit would
+    # record trace-time once): the GPipe schedule shows up in the
+    # critical-path report + stage histograms with the other planes. The
+    # span syncs the result, so it stays off the default observe tier —
+    # default-config callers keep fully async dispatch
+    from demodel_tpu.utils import trace
+
+    if isinstance(xmb, jax.core.Tracer) or not trace.enabled():
+        return run()
+    with trace.span("compute.gpipe", stages=int(n_stages), microbatches=int(M)):
+        out = run()
+        # demodel: allow(no-host-sync-in-hot-path) — observability-only
+        # sync so the span times the schedule's compute, not its
+        # dispatch; only runs under opted-in export tracing
+        jax.block_until_ready(out)
+        return out
 
 
 def pipeline_stage_spec(ndim: int) -> P:

@@ -187,9 +187,16 @@ class ProxyServer:
             self._h, f"{model}/{tensor}".encode())
 
     def metrics(self) -> dict:
-        buf = ctypes.create_string_buffer(2048)
-        self._lib.dm_proxy_metrics(self._h, buf, 2048)
-        return json.loads(buf.value.decode())
+        # dm_proxy_metrics returns the full JSON length; the per-route
+        # histograms make the document variable-size, so grow and retry
+        # when the first buffer truncates
+        cap = 8192
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.dm_proxy_metrics(self._h, buf, cap)
+            if n < cap:
+                return json.loads(buf.value.decode())
+            cap = n + 1
 
     def wait(self) -> None:
         self._stop_evt.wait()

@@ -449,6 +449,18 @@ def make_handler(registry: RestoreRegistry, proxy=None):
                 body = metrics.render(proxy=proxy, store=registry.store).encode()
                 self._send(200, body, ctype="text/plain; version=0.0.4")
                 return
+            if self.path == "/debug/statusz":
+                # live introspection: open breakers, budget charge,
+                # in-flight span tree, flight-recorder state — "what is
+                # this node doing right now", from curl
+                from demodel_tpu.utils import statusz
+
+                doc = statusz.snapshot(extra={
+                    "server": "restore",
+                    "models": registry.models(),
+                })
+                self._send(200, json.dumps(doc, default=str).encode())
+                return
             if self.path == "/restore/models":
                 self._send(200, json.dumps({"models": registry.models()}).encode())
                 return

@@ -184,10 +184,10 @@ class PeerBlobReader:
         if offset < 0 or offset + length > self._size:
             raise IOError(f"window [{offset}, {offset + length}) outside "
                           f"object of {self._size} bytes")
-        if not trace.enabled():
-            # span() args are evaluated eagerly — guard so the disabled
-            # hot path pays neither the attrs dict nor the _snapshot()
-            # lock acquire per window
+        if not trace.active():
+            # span() args are evaluated eagerly — guard so the fully
+            # disabled (DEMODEL_OBS=0) hot path pays neither the attrs
+            # dict nor the _snapshot() lock acquire per window
             return self._pread_into_traced(view, length, offset,
                                            trace.NOOP)
         with trace.span("window-read", key=self.remote_key, offset=offset,
@@ -231,7 +231,7 @@ class PeerBlobReader:
                             f"window [{offset}, +{length}) of "
                             f"{self.remote_key} failed at +{got} after "
                             f"{attempt} attempt(s): {e.cause}") from e.cause
-                    count_retry(peer)
+                    count_retry(peer, delay)
                     switched = self._fail_over(peer, exclude=cannot_serve)
                     sp.event("retry", attempt=attempt, peer=peer,
                              resume_at=got,

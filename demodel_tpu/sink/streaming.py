@@ -24,6 +24,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import weakref
 
 from jax.sharding import Mesh
 
@@ -54,13 +55,22 @@ class ByteBudget:
     allocation, so N fetch workers cannot pin N full shards regardless of
     queue bounds. A single item larger than the budget is admitted alone
     rather than deadlocking.
+
+    Every live budget sits in a weak registry so ``/debug/statusz`` can
+    report in-use / high-water per budget without the sink layer knowing
+    anything about the introspection surface.
     """
 
-    def __init__(self, max_bytes: int):
+    def __init__(self, max_bytes: int, name: str = "sink"):
         self.max_bytes = max_bytes
+        self.name = name
         self._in_use = 0
+        self.high_water = 0
+        self.waiters = 0
         self._cv = threading.Condition()
         self._aborted = False
+        with _budget_registry_lock:
+            _budget_registry.add(self)
 
     @property
     def in_use(self) -> int:
@@ -69,13 +79,20 @@ class ByteBudget:
 
     def acquire(self, nbytes: int) -> None:
         with self._cv:
-            while (self._in_use > 0 and self._in_use + nbytes > self.max_bytes
-                   and not self._aborted):
-                # pure wait: every state change that can unblock this
-                # predicate (release, abort) notify_all()s, so no timeout
-                # poll is needed — waiters wake on the event, not 0.2s late
-                self._cv.wait()
+            self.waiters += 1
+            try:
+                while (self._in_use > 0
+                       and self._in_use + nbytes > self.max_bytes
+                       and not self._aborted):
+                    # pure wait: every state change that can unblock this
+                    # predicate (release, abort) notify_all()s, so no timeout
+                    # poll is needed — waiters wake on the event, not 0.2s late
+                    self._cv.wait()
+            finally:
+                self.waiters -= 1
             self._in_use += nbytes
+            if self._in_use > self.high_water:
+                self.high_water = self._in_use
 
     def release(self, nbytes: int) -> None:
         with self._cv:
@@ -87,6 +104,29 @@ class ByteBudget:
         with self._cv:
             self._aborted = True
             self._cv.notify_all()
+
+    def describe(self) -> dict:
+        """statusz snapshot: capacity, live charge, high-water, blocked
+        acquirers — "is the pull stuck on admission" at a glance."""
+        with self._cv:
+            return {"name": self.name, "max_bytes": self.max_bytes,
+                    "in_use_bytes": self._in_use,
+                    "high_water_bytes": self.high_water,
+                    "waiters": self.waiters, "aborted": self._aborted}
+
+
+#: weak set of live budgets — statusz iterates it; a collected budget
+#: (pull finished, sink dropped) falls out on its own
+_budget_registry_lock = threading.Lock()
+_budget_registry: "weakref.WeakSet[ByteBudget]" = weakref.WeakSet()
+
+
+def budgets_snapshot() -> list[dict]:
+    """Live budgets, described — the statusz "budgets" section."""
+    with _budget_registry_lock:
+        budgets = list(_budget_registry)
+    return sorted((b.describe() for b in budgets),
+                  key=lambda d: str(d["name"]))
 
 
 class _Cancelled(Exception):

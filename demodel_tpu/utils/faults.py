@@ -216,7 +216,7 @@ class RetryPolicy:
                     # claiming the probe slot)
                     raise
                 delay = min(self.next_delay(attempt), max(0.0, left))
-                count_retry(peer)
+                count_retry(peer, delay)
                 trace.event("retry", attempt=attempt, peer=peer,
                             error=f"{type(e).__name__}: {e}",
                             backoff_secs=round(delay, 4))
@@ -336,6 +336,24 @@ class CircuitBreaker:
                         "cooling down %.1fs", self.peer, self._failures,
                         self.cooldown)
 
+    def describe(self) -> dict[str, Any]:
+        """Live-state snapshot for ``/debug/statusz``: state name,
+        consecutive failures, cooldown, and — when open — how long the
+        peer has been cooling (the "which peer is the breaker punishing"
+        answer, readable from curl)."""
+        with self._lock:
+            out: dict[str, Any] = {
+                "state": _STATE_NAMES.get(self._state, str(self._state)),
+                "failures": self._failures,
+                "threshold": self.threshold,
+                "cooldown_sec": self.cooldown,
+            }
+            if self._state != STATE_CLOSED:
+                out["open_age_sec"] = round(
+                    max(0.0, self._clock() - self._opened_at), 3)
+                out["probe_in_flight"] = self._probing
+            return out
+
     def _set_state(self, state: int) -> None:
         # caller holds self._lock
         self._state = state
@@ -402,6 +420,14 @@ class PeerHealth:
     def record_failure(self, peer: str) -> None:
         self.breaker(peer).record_failure()
 
+    def describe(self) -> dict[str, dict[str, Any]]:
+        """``peer → breaker snapshot`` for every peer this process has
+        talked to (statusz). Read-only: never creates breakers, never
+        touches probe slots."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {peer: b.describe() for peer, b in sorted(breakers.items())}
+
     def healthy(self, peers: list[str]) -> list[str]:
         """``peers`` filtered to those the breakers admit, order preserved
         — read-only (:meth:`admissible`), so building a rotation burns no
@@ -415,10 +441,15 @@ class PeerHealth:
 # ------------------------------------------------------------------ metrics
 
 
-def count_retry(peer: str | None) -> None:
-    """One retry happened against ``peer`` (or an upstream when None)."""
+def count_retry(peer: str | None, delay: float | None = None) -> None:
+    """One retry happened against ``peer`` (or an upstream when None);
+    ``delay`` (the jittered backoff about to be slept) feeds the
+    ``retry_delay_seconds`` histogram — backoff time is invisible wall
+    clock unless it lands on the scrape as a distribution."""
     name = "peer_retries_total"
     metrics.HUB.inc(metrics.labeled(name, peer=peer) if peer else name)
+    if delay is not None:
+        metrics.HUB.observe("retry_delay_seconds", delay)
 
 
 # ------------------------------------------------------------ request choke
@@ -467,7 +498,7 @@ def request_with_retry(
         return pol.call(one_attempt, what=what or f"{method} {url}",
                         peer=peer, health=health)
 
-    if not trace.enabled():
+    if not trace.active():
         return run()
     with trace.span("http.request", method=method, url=url,
                     peer=peer) as sp:

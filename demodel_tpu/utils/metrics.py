@@ -1,21 +1,81 @@
-"""Process-wide counters + Prometheus exposition.
+"""Process-wide counters, gauges, histograms + Prometheus exposition.
 
 The reference has no metrics surface at all (SURVEY.md §5 — two
 ``fmt.Println`` hooks); the rebuild exposes one ``/metrics`` endpoint that
 merges three sources: Python-side counters (this HUB), the native proxy's
-atomic counters (``dm_proxy_metrics`` JSON), and store gauges computed from
-the content-addressed index.
+atomic counters + per-route latency histograms (``dm_proxy_metrics`` JSON),
+and store gauges computed from the content-addressed index.
+
+Histograms are fixed log-bucketed (×2 per bucket from 100 µs to ~52 s):
+no per-histogram configuration means ``observe()`` is one bisect + three
+adds under the hub lock, and every exposition consumer shares one ``le``
+schedule — server-side and client-side p99s are directly comparable.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
-from typing import Any
+from bisect import bisect_left
+from typing import Any, Sequence
+
+#: shared exponential bucket bounds (seconds): 1e-4 · 2^i — 100 µs doubling
+#: up to ~52 s, +Inf implicit. One schedule for every duration histogram,
+#: Python and native, so cross-surface quantiles line up bucket-for-bucket.
+BUCKET_BOUNDS: tuple[float, ...] = tuple(1e-4 * 2 ** i for i in range(20))
+
+
+def le_str(bound: float) -> str:
+    """Canonical ``le`` label text for a bucket bound (``+Inf`` safe)."""
+    if bound == float("inf"):
+        return "+Inf"
+    return "%.6g" % bound
+
+
+class Histogram:
+    """Log-bucketed distribution: counts per bucket (last = +Inf overflow),
+    running sum and count. NOT thread-safe on its own — the hub serializes
+    ``observe`` under its lock; standalone users bring their own."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Sequence[float] = BUCKET_BOUNDS) -> None:
+        self.bounds: tuple[float, ...] = tuple(bounds)
+        self.counts: list[int] = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def quantile(self, q: float) -> float:
+        return hist_quantile(self.bounds, self.counts, q)
+
+
+def hist_quantile(bounds: Sequence[float], counts: Sequence[int],
+                  q: float) -> float:
+    """Upper-bound quantile estimate from per-bucket (non-cumulative)
+    counts: the bound of the bucket holding the q-th sample — the honest
+    answer a log-bucketed histogram can give (within one ×2 bucket).
+    +Inf-bucket hits report the largest finite bound (there is no upper
+    bound to quote). Empty histogram → 0."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = max(1.0, q * total)
+    seen = 0
+    for i, n in enumerate(counts):
+        seen += n
+        if seen >= rank and n:
+            return bounds[i] if i < len(bounds) else bounds[-1]
+    return bounds[-1]
 
 
 class Hub:
-    """Thread-safe named counters (monotonic) and gauges (point-in-time).
+    """Thread-safe named counters (monotonic), gauges (point-in-time) and
+    histograms (log-bucketed distributions).
 
     Names may carry a Prometheus label suffix built by :func:`labeled`
     (``peer_retries_total{peer="http://a:8080"}``) — the exposition
@@ -26,6 +86,7 @@ class Hub:
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
+        self._hists: dict[str, Histogram] = {}
 
     def inc(self, name: str, amount: float = 1) -> None:
         with self._lock:
@@ -35,6 +96,16 @@ class Hub:
         with self._lock:
             self._gauges[name] = value
 
+    def observe(self, name: str, value: float) -> None:
+        """One histogram sample (seconds for latency series). Creates the
+        histogram on first observation — the fixed bucket schedule means
+        there is nothing else to configure."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.observe(value)
+
     def get(self, name: str) -> float:
         with self._lock:
             return self._counters.get(name, 0)
@@ -42,6 +113,18 @@ class Hub:
     def get_gauge(self, name: str) -> float:
         with self._lock:
             return self._gauges.get(name, 0)
+
+    def get_histogram(self, name: str) -> Histogram | None:
+        """Point-in-time copy of one histogram (None when never observed)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                return None
+            out = Histogram(h.bounds)
+            out.counts = list(h.counts)
+            out.sum = h.sum
+            out.count = h.count
+            return out
 
     def snapshot(self) -> dict[str, float]:
         with self._lock:
@@ -51,10 +134,21 @@ class Hub:
         with self._lock:
             return dict(self._gauges)
 
+    def histograms(self) -> dict[str, dict[str, Any]]:
+        """``name → {le, counts, sum, count}`` snapshot (counts per bucket,
+        non-cumulative; the exposition cumulates)."""
+        with self._lock:
+            return {
+                name: {"le": list(h.bounds), "counts": list(h.counts),
+                       "sum": h.sum, "count": h.count}
+                for name, h in self._hists.items()
+            }
+
     def reset(self) -> None:  # tests only
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._hists.clear()
 
 
 HUB = Hub()
@@ -92,23 +186,72 @@ def _emit(lines: list[str], items: dict[str, float], mtype: str) -> None:
         lines.append(f"demodel_{name} {_fmt(value)}")
 
 
+def _with_label(name: str, key: str, value: str) -> str:
+    """Splice one more label into a (possibly already-labeled) sample name:
+    ``x{a="b"}`` + ``le=0.1`` → ``x{a="b",le="0.1"}``."""
+    base, brace, rest = name.partition("{")
+    if brace:
+        return f'{base}{{{rest[:-1]},{key}="{value}"}}'
+    return f'{base}{{{key}="{value}"}}'
+
+
+def _emit_hist(lines: list[str], prefix: str, name: str,
+               le: Sequence[float], counts: Sequence[int], total_sum: float,
+               count: int, emitted_types: set[str]) -> None:
+    """One histogram series in exposition shape: cumulative ``_bucket``
+    samples (one per bound + ``+Inf``), then ``_sum``/``_count``. The
+    ``# TYPE`` line is per base name — labeled series of one metric
+    (``span=...``, ``route=...``) share it via ``emitted_types``."""
+    base = name.split("{", 1)[0]
+    metric_base = f"{prefix}{base}"
+    if metric_base not in emitted_types:
+        emitted_types.add(metric_base)
+        lines.append(f"# TYPE {metric_base} histogram")
+    cum = 0
+    bounds = [*le, float("inf")]
+    for bound, n in zip(bounds, counts):
+        cum += int(n)
+        sample = _with_label(f"{base}_bucket" + name[len(base):],
+                             "le", le_str(bound))
+        lines.append(f"{prefix}{sample} {cum}")
+    labels = name[len(base):]
+    lines.append(f"{prefix}{base}_sum{labels} {_fmt(float(total_sum))}")
+    lines.append(f"{prefix}{base}_count{labels} {count}")
+
+
 def render(proxy: Any = None, store: Any = None) -> str:
-    """Prometheus text exposition (0.0.4): HUB counters/gauges as
-    ``demodel_<name>``, native proxy counters as ``demodel_proxy_<name>``,
-    store gauges as ``demodel_store_{objects,bytes}``."""
+    """Prometheus text exposition (0.0.4): HUB counters/gauges/histograms
+    as ``demodel_<name>``, native proxy counters + per-route histograms as
+    ``demodel_proxy_<name>``, store gauges as
+    ``demodel_store_{objects,bytes}``."""
     lines: list[str] = []
     _emit(lines, HUB.snapshot(), "counter")
     _emit(lines, HUB.gauges(), "gauge")
+    hist_types: set[str] = set()
+    for name, h in sorted(HUB.histograms().items()):
+        _emit_hist(lines, "demodel_", name, h["le"], h["counts"],
+                   h["sum"], h["count"], hist_types)
     if proxy is not None:
         try:
             native = proxy.metrics()
         except Exception:  # noqa: BLE001 — metrics must never take a node down
             native = {}
+        hists = native.pop("hist", None)
         for name, value in sorted(native.items()):
+            if not isinstance(value, (int, float)):
+                continue  # forward-compat: unknown structured sub-objects
             metric = f"demodel_proxy_{name}"
             mtype = "gauge" if name in PROXY_GAUGES else "counter"
             lines.append(f"# TYPE {metric} {mtype}")
             lines.append(f"{metric} {_fmt(value)}")
+        if isinstance(hists, dict):
+            for family, spec in sorted(hists.items()):
+                le = spec.get("le", [])
+                for route, h in sorted(spec.get("routes", {}).items()):
+                    _emit_hist(lines, "demodel_proxy_",
+                               labeled(family, route=route), le,
+                               h.get("counts", []), h.get("sum", 0.0),
+                               int(h.get("count", 0)), hist_types)
     if store is not None:
         try:
             idx = store.index().get("keys", [])

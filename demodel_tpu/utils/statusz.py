@@ -1,0 +1,83 @@
+"""Live-node introspection: the ``/debug/statusz`` JSON document.
+
+A production node must answer "what are you doing RIGHT NOW" from curl,
+without a restart and without pre-enabled tracing: which peer is the
+breaker punishing, what is the ByteBudget charged with, which spans are
+open (and for how long), and what the flight recorder holds. This module
+assembles that document from the places the state already lives —
+:mod:`demodel_tpu.utils.faults` (breakers), :mod:`demodel_tpu.utils.trace`
+(in-flight spans + recorder), :mod:`demodel_tpu.sink.streaming`
+(budgets) — and the servers (Python restore server, native proxy via its
+own C++ twin) expose it at ``GET /debug/statusz``.
+
+Deliberately lazy about heavyweight subsystems: a subsystem that was
+never imported has no live state worth reporting, so this module reads
+``sys.modules`` instead of importing — a dep-light serve node stays
+dep-light, and a statusz scrape never triggers a multi-second jax import.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any
+
+from demodel_tpu.utils import metrics, trace
+
+#: process start, for the uptime field (module import is close enough —
+#: statusz is assembled lazily, but utils.metrics/trace load at bring-up)
+_START_MONOTONIC = time.monotonic()
+_START_WALL = time.time()
+
+SCHEMA_VERSION = 1
+
+
+def _breakers() -> dict[str, dict[str, Any]]:
+    faults = sys.modules.get("demodel_tpu.utils.faults")
+    if faults is None:
+        return {}
+    health = faults.PeerHealth._shared  # noqa: SLF001 — read-only peek:
+    # shared() would CREATE the registry; statusz must observe, not allocate
+    if health is None:
+        return {}
+    out: dict[str, dict[str, Any]] = health.describe()
+    return out
+
+
+def _budgets() -> list[dict[str, Any]]:
+    streaming = sys.modules.get("demodel_tpu.sink.streaming")
+    if streaming is None:
+        return []
+    out: list[dict[str, Any]] = streaming.budgets_snapshot()
+    return out
+
+
+def snapshot(extra: dict[str, Any] | None = None) -> dict[str, Any]:
+    """The statusz document. ``extra`` lets a server add its own section
+    (registered models, bind address) without forking the schema."""
+    recorder = trace.recorder()
+    doc: dict[str, Any] = {
+        "statusz": SCHEMA_VERSION,
+        "pid": os.getpid(),
+        "time": time.time(),
+        "uptime_sec": round(time.monotonic() - _START_MONOTONIC, 3),
+        "start_time": _START_WALL,
+        "trace": {
+            "mode": trace.mode(),
+            "buffer_spans": len(trace.buffer()),
+            "recorder_spans": len(recorder),
+            "recorder_dropped": recorder.dropped,
+            "last_dump": trace._get_state().last_dump,  # noqa: SLF001 —
+            # the one writer of this field is dump_recorder in the same
+            # package; exposing a public accessor for one read is noise
+        },
+        "inflight_spans": trace.inflight_tree(),
+        "breakers": _breakers(),
+        "budgets": _budgets(),
+        "counters": metrics.HUB.snapshot(),
+        "gauges": metrics.HUB.gauges(),
+    }
+    if extra:
+        doc.update(extra)
+    return doc

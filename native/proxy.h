@@ -94,10 +94,55 @@ struct ProxyConfig {
   int max_conns = 0;
 };
 
+// Log-bucketed latency histogram (the Prometheus-shaped distribution the
+// Python scrape renders as *_bucket/_sum/_count): fixed ×2 buckets from
+// 100 µs to ~52 s — the SAME schedule as utils/metrics.BUCKET_BOUNDS, so
+// server-side and client-side p99s compare bucket-for-bucket. observe()
+// is a handful of relaxed atomic adds — nanoseconds, no locks, safe from
+// every serving thread.
+struct Hist {
+  static constexpr int kBuckets = 20;  // bounds 1e-4 * 2^i; last+1 = +Inf
+  std::atomic<uint64_t> buckets[kBuckets + 1] = {};
+  std::atomic<uint64_t> count{0};
+  std::atomic<uint64_t> sum_ns{0};  // atomic<double> has no fetch_add pre-C++20
+
+  static double bound(int i) { return 1e-4 * static_cast<double>(1ll << i); }
+
+  void observe(double sec) {
+    int i = 0;
+    while (i < kBuckets && sec > bound(i)) i++;
+    buckets[i].fetch_add(1, std::memory_order_relaxed);
+    count.fetch_add(1, std::memory_order_relaxed);
+    sum_ns.fetch_add(static_cast<uint64_t>(sec * 1e9),
+                     std::memory_order_relaxed);
+  }
+};
+
+// Serve routes the per-route latency/TTFB histograms are keyed by.
+enum Route {
+  kRouteHealthz = 0,
+  kRouteStatusz,
+  kRoutePeerIndex,
+  kRoutePeerMeta,
+  kRoutePeerObject,
+  kRouteRestoreTensor,
+  kRouteProxy,  // MITM / absolute-form traffic (cache hits + forwards)
+  kRouteOther,
+  kRouteCount,
+};
+
+extern const char *const kRouteNames[kRouteCount];
+
 struct Metrics {
   std::atomic<uint64_t> connects{0}, mitm{0}, tunnel{0}, requests{0},
       cache_hits{0}, cache_misses{0}, bytes_up{0}, bytes_down{0},
       bytes_cache{0}, errors{0};
+  // per-route serve latency (request head parsed → response fully
+  // written) and TTFB (→ first response byte written); exported under
+  // "hist" in the metrics JSON, typed histogram in the Python exposition
+  Hist route_latency[kRouteCount];
+  Hist route_ttfb[kRouteCount];
+  std::string hist_json() const;
   // serve-plane executor: *_active/*_queue_depth are gauges (refreshed by
   // Proxy::metrics_json from the live pool state), the rest are counters.
   // serve_bytes_total counts every body byte served to clients out of the
@@ -160,7 +205,12 @@ class Proxy {
   Metrics &metrics() { return metrics_; }
   // metrics JSON with the pool gauges (sessions_active/queue_depth/parked)
   // refreshed from live state — what /metrics and dm_proxy_metrics serve
+  // (includes the per-route latency histograms under "hist")
   std::string metrics_json();
+  // live-introspection JSON for GET /debug/statusz: uptime, resolved
+  // config, connection/pool/reactor state, restore-map and fill counts —
+  // the native twin of the Python side's utils/statusz.snapshot()
+  std::string statusz_json();
   int session_threads() const { return session_threads_; }
   int idle_timeout_sec() const { return idle_timeout_sec_; }
   bool reactor_enabled() const { return reactor_enabled_; }
@@ -224,6 +274,10 @@ class Proxy {
   int port_ = 0;
   std::thread accept_thread_;
   std::atomic<uint64_t> gc_tick_{0};
+  // start() stamps both clocks: steady for uptime math, wall for the
+  // statusz start_time field
+  std::chrono::steady_clock::time_point started_at_{};
+  double started_wall_ = 0.0;
 
   // bounded session executor: the ready queue feeds the fixed worker pool.
   // Reactor mode: the reactor pushes sessions whose fd went readable (and

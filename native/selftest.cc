@@ -316,6 +316,29 @@ static std::string pool_get(int port, const char *path) {
   return out;
 }
 
+static void test_hist_buckets() {
+  dm::Hist h;
+  h.observe(0.00005);  // below the first bound → bucket 0
+  h.observe(0.0001);   // exactly on the bound → still bucket 0 (le semantics)
+  h.observe(0.00011);  // just past it → bucket 1
+  h.observe(1e9);      // beyond every bound → +Inf overflow bucket
+  CHECK(h.buckets[0].load() == 2, "hist bucket 0");
+  CHECK(h.buckets[1].load() == 1, "hist bucket 1");
+  CHECK(h.buckets[dm::Hist::kBuckets].load() == 1, "hist +Inf bucket");
+  CHECK(h.count.load() == 4, "hist count");
+  CHECK(h.sum_ns.load() > 0, "hist sum");
+  // the JSON shape the Python exposition consumes: both families, only
+  // routes with samples, counts array of kBuckets+1
+  dm::Metrics m;
+  m.route_latency[dm::kRoutePeerObject].observe(0.002);
+  m.route_ttfb[dm::kRoutePeerObject].observe(0.001);
+  std::string j = m.hist_json();
+  CHECK(j.find("\"serve_request_seconds\"") != std::string::npos, "family 1");
+  CHECK(j.find("\"serve_ttfb_seconds\"") != std::string::npos, "family 2");
+  CHECK(j.find("\"peer_object\"") != std::string::npos, "sampled route");
+  CHECK(j.find("\"peer_meta\"") == std::string::npos, "quiet route omitted");
+}
+
 static void test_session_pool(const std::string &root) {
   dm::ProxyConfig cfg;
   cfg.host = "127.0.0.1";
@@ -837,6 +860,43 @@ static void test_reactor_stop_parked(const std::string &root) {
   delete p;
 }
 
+static void test_statusz_endpoint(const std::string &root) {
+  // GET /debug/statusz answers live JSON: identity, resolved config,
+  // connection state, and the metrics document with both histogram
+  // families; served requests land in their route's histogram
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/statuszstore";
+  cfg.verbose = false;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "statusz proxy start");
+  int port = p->port();
+
+  std::string resp = pool_get(port, "/debug/statusz");
+  CHECK(resp.find("200 OK") != std::string::npos, "statusz 200");
+  CHECK(resp.find("\"server\":\"demodel-native-proxy\"") != std::string::npos,
+        "statusz identity");
+  CHECK(resp.find("\"conns\":{\"live\":") != std::string::npos,
+        "statusz conn state");
+  CHECK(resp.find("\"config\":{\"reactor\":") != std::string::npos,
+        "statusz resolved config");
+  CHECK(resp.find("\"hist\":{") != std::string::npos, "statusz histograms");
+
+  // the first statusz request has finished, so by the second one its
+  // latency must sit in the statusz route histogram; healthz likewise
+  pool_get(port, "/healthz");
+  std::string again = pool_get(port, "/debug/statusz");
+  CHECK(again.find("\"statusz\":{\"counts\":[") != std::string::npos,
+        "statusz route observed");
+  CHECK(again.find("\"healthz\":{\"counts\":[") != std::string::npos,
+        "healthz route observed");
+  CHECK(again.find("\"serve_ttfb_seconds\"") != std::string::npos,
+        "ttfb family present");
+  p->stop();
+  delete p;
+}
+
 static void test_peer_window_fetch(const std::string &root) {
   // a proxy whose store holds one ~8 MB object; windows of it are fetched
   // back through /peer/object with the multi-stream ranged fan-out — the
@@ -908,6 +968,7 @@ int main() {
   ::signal(SIGPIPE, SIG_IGN);
   std::string root = tmpdir();
   test_sha256();
+  test_hist_buckets();
   test_store_basic(root);
   test_store_concurrent(root);
   test_store_gc_pin_stress(root);
@@ -919,6 +980,7 @@ int main() {
   test_reactor_pipelined_tls(root);
   test_reactor_max_conns(root);
   test_reactor_stop_parked(root);
+  test_statusz_endpoint(root);
   test_peer_window_fetch(root);
   if (failures) {
     ::fprintf(stderr, "%d failures\n", failures);

@@ -1,0 +1,29 @@
+"""Golden fixture: metric-hygiene.
+
+Dynamic metric names mint a time series per distinct value — unbounded
+scrape cardinality. Names must be literal snake_case; variance goes
+through metrics.labeled(). The pragma opts this file in.
+"""
+# demodel: metrics-plane
+from demodel_tpu.utils import metrics
+
+HUB = metrics.HUB
+GOOD_NAME = "pull_bytes_total"
+
+
+def record(source, peer, route, secs):
+    HUB.inc(f"pull_{source}_total")                      # f-string name
+    HUB.inc("pull-total")                                # not snake_case
+    HUB.set_gauge("peer_state_" + peer, 1)               # concatenation
+    HUB.observe("serve_%s_seconds" % route, secs)        # %-interpolation
+    HUB.inc(metrics.labeled("Pulls", peer=peer))         # bad labeled() name
+    HUB.inc("pulls_" + source + "_total".format())       # composed
+
+
+def fine(peer, secs):
+    HUB.inc("pulls_total")                               # literal: ok
+    HUB.inc(metrics.labeled("peer_retries_total", peer=peer))   # labeled: ok
+    HUB.observe("serve_seconds", secs)                   # histogram: ok
+    name = "peer_breaker_open_total"
+    HUB.inc(metrics.labeled(name, peer=peer) if peer else name)  # local literal
+    HUB.inc(GOOD_NAME)                                   # module literal

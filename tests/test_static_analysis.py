@@ -39,6 +39,7 @@ ALL_RULES = {
     "hbm-budget",
     "orphaned-async-task",
     "wire-call-policy",
+    "metric-hygiene",
 }
 
 #: fixture file → exact expected (rule, line) findings
@@ -99,6 +100,14 @@ GOLDEN = {
         ("wire-call-policy", 19),
         ("wire-call-policy", 23),
         ("wire-call-policy", 27),
+    },
+    "metric_bad.py": {
+        ("metric-hygiene", 15),
+        ("metric-hygiene", 16),
+        ("metric-hygiene", 17),
+        ("metric-hygiene", 18),
+        ("metric-hygiene", 19),
+        ("metric-hygiene", 20),
     },
     # PR 5 receiver-typing upgrades: blocking I/O reached only through a
     # constructor-typed self-attribute / an executor-submit edge

@@ -1,0 +1,542 @@
+"""Live ops plane: histograms, /debug/statusz, and the flight recorder.
+
+Covers the observability tentpole end to end, dep-light where possible
+(native proxy nodes run no_mitm, no ``cryptography``):
+
+- ``Histogram`` bucket/quantile math and the ``Hub.observe`` surface;
+- a promtool-style lint of the Prometheus exposition (``render``) — TYPE
+  lines, name hygiene, cumulative buckets, ``+Inf == _count``, ``_sum`` —
+  run over BOTH the Python histograms (span bridge, retry delays) and the
+  native per-route serve histograms;
+- ``/debug/statusz`` on the native proxy (schema, live conn state) and on
+  the Python restore server (breakers, budgets, in-flight span tree);
+- the flight recorder: always-on ring, SIGUSR2 dump, error-root autodump;
+- the acceptance scenario: mid-chaos-stall, statusz names the OPEN
+  breaker and the in-flight ``window-read`` span (age > 0), and the
+  error-triggered recorder dump contains the failing window-read.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from demodel_tpu.utils import metrics as m
+from demodel_tpu.utils import statusz, trace
+from demodel_tpu.utils.faults import PeerHealth
+
+from .chaoshttp import ChaosPeer, FaultPlan, FaultSpec
+from .test_fault_injection import _seed_store
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch, tmp_path):
+    for var in ("DEMODEL_TRACE", "DEMODEL_TRACE_SAMPLE", "DEMODEL_OBS",
+                "DEMODEL_RECORDER_CAP"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("DEMODEL_RECORDER_DIR", str(tmp_path / "recorder"))
+    (tmp_path / "recorder").mkdir(exist_ok=True)
+    monkeypatch.setenv("DEMODEL_RECORDER_MIN_S", "0")
+    trace.reset()
+    m.HUB.reset()
+    PeerHealth.reset_shared()
+    yield
+    trace.reset()
+    m.HUB.reset()
+    PeerHealth.reset_shared()
+
+
+def _dumps(tmp_path) -> list[Path]:
+    return sorted((tmp_path / "recorder").glob("demodel-flightrec-*.json"))
+
+
+# ------------------------------------------------------------ histogram math
+
+
+def test_histogram_bucket_boundaries():
+    h = m.Histogram()
+    h.observe(0.00005)   # under the first bound → bucket 0
+    h.observe(0.0001)    # exactly the bound → bucket 0 (le semantics)
+    h.observe(0.000101)  # just past → bucket 1
+    h.observe(1e6)       # beyond every bound → +Inf overflow
+    assert h.counts[0] == 2
+    assert h.counts[1] == 1
+    assert h.counts[-1] == 1
+    assert h.count == 4
+    assert h.sum == pytest.approx(0.00005 + 0.0001 + 0.000101 + 1e6)
+
+
+def test_histogram_quantiles_are_bucket_upper_bounds():
+    h = m.Histogram()
+    for _ in range(99):
+        h.observe(0.003)  # bucket le=0.0032
+    h.observe(0.1)        # bucket le=0.1024
+    assert h.quantile(0.5) == pytest.approx(0.0032)
+    assert h.quantile(0.99) == pytest.approx(0.0032)
+    assert h.quantile(1.0) == pytest.approx(0.1024)
+    assert m.Histogram().quantile(0.99) == 0.0
+    # +Inf-bucket samples report the largest finite bound (no honest upper)
+    h2 = m.Histogram()
+    h2.observe(1e6)
+    assert h2.quantile(0.99) == pytest.approx(m.BUCKET_BOUNDS[-1])
+
+
+def test_hub_observe_creates_and_accumulates():
+    m.HUB.observe("serve_seconds", 0.01)
+    m.HUB.observe("serve_seconds", 0.02)
+    h = m.HUB.get_histogram("serve_seconds")
+    assert h is not None and h.count == 2
+    assert m.HUB.get_histogram("never_observed") is None
+    snap = m.HUB.histograms()
+    assert snap["serve_seconds"]["count"] == 2
+    assert len(snap["serve_seconds"]["counts"]) == len(m.BUCKET_BOUNDS) + 1
+
+
+def test_native_and_python_bucket_schedules_match():
+    """The C++ Hist and the Python Histogram must share one le schedule —
+    cross-surface quantiles are only comparable bucket-for-bucket."""
+    for i, bound in enumerate(m.BUCKET_BOUNDS):
+        assert bound == pytest.approx(1e-4 * 2 ** i)
+    assert len(m.BUCKET_BOUNDS) == 20  # == dm::Hist::kBuckets
+
+
+# ------------------------------------------------------- exposition lint
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^}]*\})? (?P<value>\S+)$")
+_TYPE_RE = re.compile(r"^# TYPE (?P<name>\S+) (?P<type>counter|gauge|histogram)$")
+
+
+def lint_exposition(text: str) -> list[str]:
+    """promtool-style checks over a text exposition: every sample is
+    preceded by exactly one TYPE line for its family, names are
+    snake_case, values parse, histogram buckets are cumulative with
+    ``+Inf == _count`` and a ``_sum``."""
+    problems: list[str] = []
+    types: dict[str, str] = {}
+    samples: list[tuple[str, str, float]] = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        t = _TYPE_RE.match(line)
+        if t:
+            if t.group("name") in types:
+                problems.append(f"line {i}: duplicate TYPE for {t.group('name')}")
+            types[t.group("name")] = t.group("type")
+            continue
+        if line.startswith("#"):
+            continue
+        s = _SAMPLE_RE.match(line)
+        if s is None:
+            problems.append(f"line {i}: unparsable sample {line!r}")
+            continue
+        try:
+            value = float(s.group("value"))
+        except ValueError:
+            problems.append(f"line {i}: non-numeric value {line!r}")
+            continue
+        samples.append((s.group("name"), s.group("labels") or "", value))
+
+    hist_series: dict[tuple[str, str], dict[str, float]] = {}
+    for name, labels, value in samples:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types \
+                    and types[name[: -len(suffix)]] == "histogram":
+                base = name[: -len(suffix)]
+                break
+        if base not in types:
+            problems.append(f"sample {name}{labels} has no TYPE line")
+            continue
+        if not re.match(r"^[a-z][a-z0-9_]*$", base):
+            problems.append(f"metric name {base!r} is not snake_case")
+        if types[base] == "histogram":
+            no_le = re.sub(r'le="[^"]*",?', "", labels).replace(",}", "}")
+            if no_le == "{}":
+                no_le = ""  # bucket of an unlabeled family ↔ bare _sum/_count
+            key = (base, no_le)
+            series = hist_series.setdefault(key, {})
+            if name.endswith("_bucket"):
+                le = re.search(r'le="([^"]*)"', labels)
+                if le is None:
+                    problems.append(f"bucket without le: {name}{labels}")
+                else:
+                    series[f"le:{le.group(1)}"] = value
+            else:
+                series[name[len(base):]] = value
+
+    for (base, labels), series in hist_series.items():
+        les = [(k[3:], v) for k, v in series.items() if k.startswith("le:")]
+        if not les:
+            problems.append(f"{base}{labels}: no buckets")
+            continue
+        finite = sorted((float(le), v) for le, v in les if le != "+Inf")
+        values = [v for _, v in finite]
+        if values != sorted(values):
+            problems.append(f"{base}{labels}: buckets not cumulative")
+        if "le:+Inf" not in series:
+            problems.append(f"{base}{labels}: missing +Inf bucket")
+        if "_count" not in series or "_sum" not in series:
+            problems.append(f"{base}{labels}: missing _sum/_count")
+        elif "le:+Inf" in series and series["le:+Inf"] != series["_count"]:
+            problems.append(f"{base}{labels}: +Inf != _count")
+    return problems
+
+
+def test_lint_catches_broken_expositions():
+    assert lint_exposition("demodel_orphan 1") != []
+    bad_hist = "\n".join([
+        "# TYPE demodel_h histogram",
+        'demodel_h_bucket{le="0.1"} 5',
+        'demodel_h_bucket{le="0.2"} 3',  # not cumulative
+        'demodel_h_bucket{le="+Inf"} 5',
+        "demodel_h_sum 1.0",
+        "demodel_h_count 6",             # != +Inf
+    ])
+    probs = lint_exposition(bad_hist)
+    assert any("cumulative" in p for p in probs)
+    assert any("+Inf != _count" in p for p in probs)
+
+
+def test_exposition_lints_clean_with_all_sources(tmp_path):
+    """The acceptance scrape: ≥5 stages with *_bucket/_sum/_count from the
+    Python side (span bridge + retry delays) AND the native per-route
+    serve histograms, all clean under the lint."""
+    # Python side: the tracing→metrics bridge feeds per-stage histograms
+    for name in ("window-read", "budget-wait", "tensor-restore",
+                 "serve.restore", "sink-deliver"):
+        with trace.span(name):
+            pass
+    # retry delays land via the faults layer's counter helper
+    from demodel_tpu.utils.faults import count_retry
+
+    count_retry("http://peer:1", 0.25)
+
+    # native side: a dep-light node serving real hot hits
+    from demodel_tpu.config import ProxyConfig
+    from demodel_tpu.proxy import ProxyServer
+    from demodel_tpu.store import Store
+
+    cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[], no_mitm=True,
+                      cache_dir=tmp_path / "c", data_dir=tmp_path / "d")
+    store = Store(cfg.cache_dir / "proxy")
+    store.put("statuszobj0000001", b"x" * 4096,
+              {"content-type": "application/octet-stream"})
+    store.close()
+    node = ProxyServer(cfg, verbose=False).start()
+    try:
+        for path in ("/peer/object/statuszobj0000001",
+                     "/peer/meta/statuszobj0000001", "/peer/index"):
+            conn = http.client.HTTPConnection("127.0.0.1", node.port,
+                                              timeout=10)
+            conn.request("GET", path, headers={"Connection": "close"})
+            assert conn.getresponse().read() is not None
+            conn.close()
+        body = m.render(proxy=node)
+    finally:
+        node.stop()
+
+    assert lint_exposition(body) == [], lint_exposition(body)
+    for span_name in ("window-read", "budget-wait", "tensor-restore",
+                      "serve.restore"):
+        assert (f'demodel_stage_duration_seconds_bucket{{span="{span_name}"'
+                in body), span_name
+        assert f'demodel_stage_duration_seconds_count{{span="{span_name}"' \
+            in body
+    assert 'demodel_retry_delay_seconds_bucket{le="0.4096"} 1' in body
+    for route in ("peer_object", "peer_meta", "peer_index"):
+        assert (f'demodel_proxy_serve_request_seconds_bucket{{route="{route}"'
+                in body), route
+        assert f'demodel_proxy_serve_ttfb_seconds_count{{route="{route}"' \
+            in body
+
+
+# ------------------------------------------------ observe tier + recorder
+
+
+def test_observe_tier_feeds_recorder_not_exporter():
+    assert trace.mode() == "observe"
+    with trace.span("window-read"):
+        pass
+    assert len(trace.recorder()) == 1
+    assert len(trace.buffer()) == 0  # export buffer only under DEMODEL_TRACE
+    h = m.HUB.get_histogram(
+        m.labeled("stage_duration_seconds", span="window-read"))
+    assert h is not None and h.count == 1
+
+
+def test_export_tier_feeds_both():
+    trace.enable()
+    with trace.span("window-read"):
+        pass
+    assert len(trace.recorder()) == 1
+    assert len(trace.buffer()) == 1
+
+
+def test_obs_kill_switch_disables_everything(monkeypatch):
+    monkeypatch.setenv("DEMODEL_OBS", "0")
+    trace.reset()
+    assert trace.mode() == "off"
+    assert trace.span("x") is trace.NOOP
+    assert len(trace.recorder()) == 0
+    assert trace.inflight() == []
+
+
+def test_inflight_registry_tracks_open_spans():
+    with trace.span("pull", model="org/m") as root:
+        with trace.span("window-read", offset=0):
+            tree = trace.inflight_tree()
+            (r,) = [t for t in tree if t["name"] == "pull"]
+            assert r["attrs"] == {"model": "org/m"}
+            assert r["age_sec"] >= 0
+            kids = [c["name"] for c in r["children"]]
+            assert kids == ["window-read"]
+        assert root is trace.current()
+    assert trace.inflight() == []
+
+
+def test_sigusr2_dumps_recorder(tmp_path):
+    with trace.span("pull"):
+        pass
+    assert _dumps(tmp_path) == []
+    os.kill(os.getpid(), signal.SIGUSR2)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not _dumps(tmp_path):
+        time.sleep(0.01)
+    (dump,) = _dumps(tmp_path)
+    doc = json.loads(dump.read_text())
+    assert doc["kind"] == "demodel-flight-recorder"
+    assert doc["reason"] == "sigusr2"
+    assert [s["name"] for s in doc["spans"]] == ["pull"]
+
+
+def test_error_root_autodump_and_rate_limit(tmp_path, monkeypatch):
+    """An error-status ROOT leaves a post-mortem automatically; with a
+    nonzero min interval a fault storm leaves ONE dump, not one per
+    failure. Non-root errors never dump (the root will)."""
+    with trace.span("pull"):
+        try:
+            with trace.span("window-read"):
+                raise IOError("inner fails, root survives")
+        except IOError:
+            pass
+    assert _dumps(tmp_path) == []  # error was not on a ROOT
+
+    monkeypatch.setenv("DEMODEL_RECORDER_MIN_S", "3600")
+    trace.reset()
+    for _ in range(3):
+        try:
+            with trace.span("pull"):
+                with trace.span("window-read"):
+                    raise IOError("boom")
+        except IOError:
+            pass
+    dumps = _dumps(tmp_path)
+    assert len(dumps) == 1, dumps  # rate-limited
+    doc = json.loads(dumps[0].read_text())
+    assert doc["reason"] == "error-root:pull"
+    names = [s["name"] for s in doc["spans"]]
+    assert "pull" in names and "window-read" in names
+
+
+def test_recorder_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("DEMODEL_RECORDER_CAP", "16")
+    trace.reset()
+    for i in range(40):
+        with trace.span("op", i=i):
+            pass
+    rec = trace.recorder()
+    assert len(rec) == 16
+    assert rec.dropped == 24
+    assert rec.snapshot()[-1]["attrs"]["i"] == 39
+
+
+# ------------------------------------------------------- statusz snapshots
+
+
+def test_statusz_snapshot_sections():
+    from demodel_tpu.sink.streaming import ByteBudget
+
+    health = PeerHealth.shared()
+    for _ in range(3):
+        health.record_failure("http://dead:1")
+    budget = ByteBudget(1000, name="test-budget")
+    budget.acquire(600)
+    budget.release(200)
+    with trace.span("pull"):
+        doc = statusz.snapshot(extra={"server": "test"})
+    assert doc["statusz"] == 1
+    assert doc["server"] == "test"
+    assert doc["uptime_sec"] >= 0
+    assert doc["breakers"]["http://dead:1"]["state"] == "open"
+    assert doc["breakers"]["http://dead:1"]["open_age_sec"] >= 0
+    (b,) = [x for x in doc["budgets"] if x["name"] == "test-budget"]
+    assert b == {"name": "test-budget", "max_bytes": 1000,
+                 "in_use_bytes": 400, "high_water_bytes": 600,
+                 "waiters": 0, "aborted": False}
+    assert [s["name"] for s in doc["inflight_spans"]] == ["pull"]
+    assert doc["trace"]["mode"] == "observe"
+
+
+def test_native_statusz_endpoint(tmp_path):
+    from demodel_tpu.config import ProxyConfig
+    from demodel_tpu.proxy import ProxyServer
+
+    cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[], no_mitm=True,
+                      cache_dir=tmp_path / "c", data_dir=tmp_path / "d")
+    node = ProxyServer(cfg, verbose=False).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", node.port, timeout=10)
+        conn.request("GET", "/debug/statusz")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        doc = json.loads(resp.read())
+        conn.close()
+        assert doc["statusz"] == 1
+        assert doc["server"] == "demodel-native-proxy"
+        assert doc["uptime_sec"] >= 0
+        assert doc["conns"]["live"] >= 1  # the statusz conn itself
+        assert set(doc["config"]) >= {"reactor", "session_threads",
+                                      "max_conns", "idle_timeout_sec"}
+        assert "hist" in doc["metrics"]
+        # the tool's schema gate accepts it
+        proc = subprocess.run(
+            [sys.executable, "tools/statusz.py",
+             f"http://127.0.0.1:{node.port}", "--validate"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+    finally:
+        node.stop()
+
+
+# ----------------------------------------- acceptance: statusz under chaos
+
+
+@pytest.fixture()
+def _fast_chaos_wire(monkeypatch):
+    monkeypatch.setenv("DEMODEL_RETRY_BASE_MS", "20")
+    monkeypatch.setenv("DEMODEL_RETRY_MAX", "6")
+    monkeypatch.setenv("DEMODEL_RETRY_DEADLINE", "60")
+    monkeypatch.setenv("DEMODEL_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("DEMODEL_BREAKER_COOLDOWN", "30")
+    monkeypatch.setenv("DEMODEL_PROXY_IDLE_TIMEOUT", "1")
+
+
+def test_statusz_names_breaker_and_inflight_span_mid_stall(
+        tmp_path, _fast_chaos_wire):
+    """THE acceptance scenario: a chaos peer stalls every object window.
+    While the pull is stuck, /debug/statusz (served live by the restore
+    server in the same process) must name the OPEN breaker for that peer
+    and show the in-flight window-read span with age > 0; when the pull
+    finally fails, the error-triggered flight-recorder dump must contain
+    the failing window-read span."""
+    from demodel_tpu.config import ProxyConfig
+    from demodel_tpu.proxy import ProxyServer
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+    from demodel_tpu.sink.remote import PeerBlobReader
+    from demodel_tpu.store import Store
+
+    cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[], no_mitm=True,
+                      cache_dir=tmp_path / "peer-cache",
+                      data_dir=tmp_path / "peer-data")
+    store = Store(cfg.cache_dir / "proxy")
+    try:
+        _tensors, files, _ = _seed_store(store, "statusztag", 2, seed=11)
+    finally:
+        store.close()
+    peer = ProxyServer(cfg, verbose=False).start()
+
+    own_store = Store(tmp_path / "own-store")
+    server = RestoreServer(RestoreRegistry(own_store),
+                           host="127.0.0.1").start()
+
+    def statusz_doc() -> dict:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        try:
+            conn.request("GET", "/debug/statusz")
+            return json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+
+    def flatten(tree):
+        for node in tree:
+            yield node
+            yield from flatten(node.get("children", []))
+
+    plan = FaultPlan(
+        FaultSpec(kind="stall", path="/peer/object", times=99,
+                  stall_secs=1.0),
+    )
+    pull_err: list[BaseException] = []
+    try:
+        with ChaosPeer(peer.url, plan) as shim:
+            f = files[0]
+
+            def doomed_pull():
+                reader = PeerBlobReader(shim.url, f["key"], f["size"])
+                out = np.empty(f["size"], dtype=np.uint8)
+                try:
+                    reader.pread_into(f["key"], out, 0)
+                except IOError as e:
+                    pull_err.append(e)
+
+            t = threading.Thread(target=doomed_pull, daemon=True)
+            t.start()
+
+            observed = None
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline and t.is_alive():
+                doc = statusz_doc()
+                open_peers = [p for p, b in doc["breakers"].items()
+                              if b["state"] == "open"]
+                window_reads = [
+                    s for s in flatten(doc["inflight_spans"])
+                    if s["name"] == "window-read" and s["age_sec"] > 0]
+                if open_peers and window_reads:
+                    observed = (doc, open_peers, window_reads)
+                    break
+                time.sleep(0.05)
+            t.join(timeout=60)
+            assert not t.is_alive(), "chaos pull never finished"
+    finally:
+        server.stop()
+        own_store.close()
+        peer.stop()
+
+    assert observed is not None, \
+        "statusz never showed an open breaker + in-flight window-read"
+    doc, open_peers, window_reads = observed
+    assert shim.url.rstrip("/") in open_peers, (open_peers, shim.url)
+    assert window_reads[0]["age_sec"] > 0
+    assert pull_err, "the stalled pull was expected to fail"
+
+    # the pull's failure left a post-mortem: the error-root dump holds the
+    # failing window-read (status=error) without tracing ever enabled
+    dumps = _dumps(tmp_path)
+    assert dumps, "no error-triggered flight-recorder dump"
+    doc = json.loads(dumps[-1].read_text())
+    failed = [s for s in doc["spans"]
+              if s["name"] == "window-read" and s["status"] == "error"]
+    assert failed, [s["name"] for s in doc["spans"]]
+    assert plan.fired("stall") >= 2
+
+    # ...and the scrape carries the window-read latency distribution
+    body = m.render()
+    assert 'demodel_stage_duration_seconds_count{span="window-read"}' in body
+    assert lint_exposition(body) == []

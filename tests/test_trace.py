@@ -41,10 +41,14 @@ NOOP_BUDGET_SECS = 5e-6
 
 
 @pytest.fixture(autouse=True)
-def _fresh_trace_state(monkeypatch):
+def _fresh_trace_state(monkeypatch, tmp_path):
     monkeypatch.delenv("DEMODEL_TRACE", raising=False)
     monkeypatch.delenv("DEMODEL_TRACE_BUFFER", raising=False)
     monkeypatch.delenv("DEMODEL_TRACE_SAMPLE", raising=False)
+    monkeypatch.delenv("DEMODEL_OBS", raising=False)
+    # error-status roots in these tests must not litter the real tempdir
+    # with autodump files (the recorder is ALWAYS on by design)
+    monkeypatch.setenv("DEMODEL_RECORDER_DIR", str(tmp_path))
     trace.reset()
     m.HUB.reset()
     PeerHealth.reset_shared()
@@ -64,10 +68,14 @@ def _by_name(name):
 # ------------------------------------------------------------ fundamentals
 
 
-def test_disabled_span_is_noop_and_cheap():
-    """The overhead guard: with tracing off, span() must return the
-    shared no-op after one global check — no allocation, no clock."""
+def test_disabled_span_is_noop_and_cheap(monkeypatch):
+    """The overhead guard: with observability fully OFF (DEMODEL_OBS=0 —
+    the kill switch below the default observe tier), span() must return
+    the shared no-op after one global check — no allocation, no clock."""
+    monkeypatch.setenv("DEMODEL_OBS", "0")
+    trace.reset()
     assert not trace.enabled()
+    assert not trace.active()
     s = trace.span("anything", key="value")
     assert s is trace.NOOP
     assert trace.current() is None
@@ -274,15 +282,22 @@ def test_jsonl_sink_writes_parseable_lines(tmp_path, monkeypatch):
 
 
 def test_sample_zero_drops_whole_traces(monkeypatch):
-    """DEMODEL_TRACE_SAMPLE=0: a new root is dropped and its descendants
-    are suppressed WITH it — never re-rolled into orphan fragments."""
+    """DEMODEL_TRACE_SAMPLE=0: a new root drops from the EXPORT and its
+    descendants drop WITH it — never re-rolled into orphan fragments.
+    The spans still RUN: sampling is an export-volume knob, so the
+    always-on surfaces (flight recorder, stage histograms) stay whole."""
     monkeypatch.setenv("DEMODEL_TRACE_SAMPLE", "0")
     trace.enable()
     with trace.span("root") as root:
-        assert not isinstance(root, trace.Span)
+        assert isinstance(root, trace.Span)
         with trace.span("child") as child:
-            assert child is trace.NOOP
-    assert _records() == []
+            assert isinstance(child, trace.Span)
+            assert child.trace_id == root.trace_id
+    assert _records() == []  # nothing exported
+    assert {r["name"] for r in trace.recorder().snapshot()} == {
+        "root", "child"}  # recorder unaffected by the export knob
+    assert m.HUB.get_histogram(
+        m.labeled("stage_duration_seconds", span="root")) is not None
 
 
 def test_sample_one_records_everything(monkeypatch):
@@ -325,7 +340,8 @@ def test_remote_parented_span_bypasses_sampling(monkeypatch):
 
 def test_unsampled_root_crosses_wrap(monkeypatch):
     """A dropped trace's thread fan-out must not re-roll per task: wrap()
-    carries the unsampled mark across the executor boundary."""
+    carries the unsampled mark across the executor boundary, so the
+    task's span runs but drops from the export with its root."""
     monkeypatch.setenv("DEMODEL_TRACE_SAMPLE", "0")
     trace.enable()
     out = []
@@ -333,7 +349,9 @@ def test_unsampled_root_crosses_wrap(monkeypatch):
         fn = trace.wrap(lambda: out.append(trace.span("task")))
     with ThreadPoolExecutor(max_workers=1) as ex:
         ex.submit(fn).result()
-    assert out[0] is trace.NOOP
+    (task,) = out
+    assert isinstance(task, trace.Span)
+    task.finish()
     assert _records() == []
 
 

@@ -11,6 +11,7 @@ from tools.analyze.passes import (  # noqa: F401 — registration imports
     lock_io,
     lock_order,
     log_hygiene,
+    metric_hygiene,
     threads,
     wire_policy,
 )

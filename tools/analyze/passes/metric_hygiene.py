@@ -1,0 +1,144 @@
+"""metric-hygiene: non-literal metric names on the Hub surface.
+
+``HUB.inc(f"pull_{source}_total")`` mints a new time series per distinct
+value — unbounded cardinality that bloats every scrape and breaks
+aggregation (you cannot ``sum()`` a family you cannot name). The contract:
+metric NAMES passed to ``Hub.inc`` / ``Hub.set_gauge`` / ``Hub.observe``
+are literal snake_case strings, and anything dynamic (peer, span, route)
+goes through ``metrics.labeled(<literal>, key=value)`` — labels are the
+bounded, queryable place for variance.
+
+The rule resolves through the benign indirections the tree actually uses:
+a local/module name bound to a literal (``name = "peer_retries_total"``),
+an ``IfExp`` whose both arms resolve, and ``labeled(...)`` calls (whose
+first argument must itself resolve). Everything else — f-strings,
+``%``/``+``/``.format`` composition, names bound to expressions — is a
+finding.
+
+Scope: files under ``demodel_tpu/`` plus any file carrying an explicit
+``# demodel: metrics-plane`` pragma (how the golden fixture opts in).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tools.analyze.core import (
+    Finding,
+    ModuleContext,
+    Pass,
+    dotted,
+    enclosing_function,
+    register,
+)
+
+_METHODS = {"inc", "set_gauge", "observe"}
+_PRAGMA = "# demodel: metrics-plane"
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _is_labeled_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == "labeled"
+    return isinstance(f, ast.Attribute) and f.attr == "labeled"
+
+
+def _assignments_of(name: str, *scopes: ast.AST) -> list[ast.expr]:
+    """Every ``name = <expr>`` in the given scopes (function body first,
+    then module top level — the two places the tree binds metric names)."""
+    out: list[ast.expr] = []
+    for scope in scopes:
+        if scope is None:
+            continue
+        body = getattr(scope, "body", [])
+        nodes = (list(ast.walk(scope))
+                 if not isinstance(scope, ast.Module) else body)
+        for node in nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == name:
+                out.append(node.value)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and isinstance(node.target, ast.Name) \
+                    and node.target.id == name:
+                out.append(node.value)
+    return out
+
+
+class _Resolver:
+    """Resolves a metric-name expression to "fine" (None) or a reason
+    string, chasing names with a cycle guard."""
+
+    def __init__(self, call: ast.Call, ctx: ModuleContext) -> None:
+        self.fn = enclosing_function(call)
+        self.ctx = ctx
+        self.seen: set[str] = set()
+
+    def resolve(self, expr: ast.expr) -> str | None:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            if not _NAME_RE.match(expr.value):
+                return (f"metric name {expr.value!r} is not snake_case — "
+                        "labels belong in labeled(), not the name")
+            return None
+        if isinstance(expr, ast.Call) and _is_labeled_call(expr):
+            if not expr.args:
+                return "labeled() without a metric name"
+            return self.resolve(expr.args[0])
+        if isinstance(expr, ast.IfExp):
+            return self.resolve(expr.body) or self.resolve(expr.orelse)
+        if isinstance(expr, ast.JoinedStr):
+            return ("f-string metric name mints a series per value — "
+                    "unbounded cardinality; use labeled()")
+        if isinstance(expr, ast.Name):
+            if expr.id in self.seen:
+                # cycle along the CURRENT resolution chain only — the same
+                # name may legitimately appear in both arms of an IfExp
+                return f"metric name {expr.id!r} is not a literal"
+            self.seen.add(expr.id)
+            try:
+                assigns = _assignments_of(expr.id, self.fn, self.ctx.tree)
+                if not assigns:
+                    return (f"metric name {expr.id!r} does not resolve to "
+                            "a literal in this scope")
+                for value in assigns:
+                    reason = self.resolve(value)
+                    if reason:
+                        return reason
+                return None
+            finally:
+                self.seen.discard(expr.id)
+        return ("computed metric name (%/+/.format/expression) — "
+                "names must be literal snake_case, variance via labeled()")
+
+
+@register
+class MetricHygienePass(Pass):
+    id = "metric-hygiene"
+    description = (
+        "metric names passed to Hub.inc/set_gauge/observe must be literal "
+        "snake_case (labels only via metrics.labeled) — dynamic names are "
+        "unbounded scrape cardinality"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not (ctx.rel.startswith("demodel_tpu/")
+                or _PRAGMA in ctx.source):
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METHODS
+                    and node.args):
+                continue
+            recv = dotted(node.func.value)
+            if recv is None:
+                continue
+            last = recv.rsplit(".", 1)[-1]
+            if last not in ("HUB", "hub"):
+                continue
+            reason = _Resolver(node, ctx).resolve(node.args[0])
+            if reason:
+                yield Finding(ctx.rel, node.lineno, self.id, reason)

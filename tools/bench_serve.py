@@ -281,6 +281,46 @@ def _flood(tmp: Path) -> dict:
     return flood
 
 
+def _server_p99(native: dict, family: str, route: str) -> float | None:
+    """p99 from the native per-route histogram (bucket upper bound —
+    log-bucketed, so quantized to the ×2 schedule), or None when the
+    build/route has no histogram."""
+    fam = native.get("hist", {}).get(family, {})
+    r = fam.get("routes", {}).get(route)
+    if not r:
+        return None
+    from demodel_tpu.utils.metrics import hist_quantile
+
+    return hist_quantile(fam["le"], r["counts"], 0.99)
+
+
+def _hist_crosscheck(native: dict, out: dict) -> dict:
+    """Server-side per-route p99 (native histograms) vs the client-observed
+    p99 of the same leg: the two views of one distribution must agree
+    within the log-bucket quantization (×2 per bucket) plus scheduling
+    noise. Catches a silently wrong observe() unit or bucket math — a
+    seconds/ms mixup is 1000× off, far outside any honest tolerance."""
+    checks = {}
+    for family, suffix in (("serve_request_seconds", ""),
+                           ("serve_ttfb_seconds", "_ttfb")):
+        sp99 = _server_p99(native, family, "peer_object")
+        if sp99 is None:
+            continue
+        checks[f"object_server{suffix}_p99_ms"] = round(sp99 * 1e3, 3)
+    sp99 = _server_p99(native, "serve_request_seconds", "peer_object")
+    cp99 = out.get("object_p99_ms", 0.0) / 1e3
+    if sp99 is not None and cp99 > 0:
+        # server p99 is a bucket UPPER bound and excludes client-side
+        # syscalls; ×8 + 2 ms absolute slack each way holds on a loaded
+        # 1-CPU CI container while still catching unit/bucket bugs
+        checks["hist_p99_agree"] = (
+            sp99 <= cp99 * 8 + 0.002 and cp99 <= sp99 * 8 + 0.002)
+    else:
+        checks["hist_p99_agree"] = None  # pre-histogram build: report-only
+    print(f"[bench_serve] hist cross-check: {checks}", file=sys.stderr)
+    return checks
+
+
 def _raise_nofile(need: int) -> None:
     import resource
 
@@ -497,6 +537,7 @@ def main() -> int:
                 "index", port, lambda w, i: "/peer/index",
                 LEG_SECS / 2, N_CLIENTS, expect_body=True))
             native = node.metrics()
+            out.update(_hist_crosscheck(native, out))
         finally:
             node.stop()
 
@@ -530,6 +571,9 @@ def main() -> int:
         return 1
     if c10k.get("c10k_ok") is False:
         print("[bench_serve] C10K CONTRACT VIOLATED", file=sys.stderr)
+        return 1
+    if out.get("hist_p99_agree") is False:
+        print("[bench_serve] HISTOGRAM/CLIENT P99 DISAGREE", file=sys.stderr)
         return 1
     return 0
 

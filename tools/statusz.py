@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Render a live ``/debug/statusz`` endpoint or a flight-recorder dump as
+ONE JSON line (the ``bench.py`` / ``trace_report.py`` contract).
+
+Sources, auto-detected:
+
+- ``http://host:port`` (or a full ``.../debug/statusz`` URL) — the live
+  endpoint of a Python restore server or the native proxy;
+- a ``demodel-flightrec-*.json`` file — the post-mortem the flight
+  recorder dumped on SIGUSR2 / an error-status root span.
+
+The report leads with what an operator triages first: open breakers, the
+oldest in-flight spans (a stuck pull shows as a ``window-read`` with a
+large ``age_sec``), budget pressure, and — for recorder dumps — the
+per-stage breakdown + error spans of the captured ring.
+
+``--validate`` exits nonzero unless the source parses AND carries the
+statusz/recorder schema — the CI statusz-smoke gate.
+
+Usage::
+
+    python tools/statusz.py http://127.0.0.1:8800
+    python tools/statusz.py /tmp/demodel-flightrec-4242-1.json
+    python tools/statusz.py http://127.0.0.1:8800 --validate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from demodel_tpu.utils.trace import nest_spans  # noqa: E402
+from tools.trace_report import stage_breakdown  # noqa: E402
+
+
+def load(source: str) -> tuple[dict, str]:
+    if source.startswith(("http://", "https://")):
+        url = source
+        if "/debug/statusz" not in url:
+            url = url.rstrip("/") + "/debug/statusz"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.loads(r.read()), url
+    return json.loads(Path(source).read_text(encoding="utf-8")), source
+
+
+def _flatten_inflight(tree: list[dict], depth: int = 0) -> list[dict]:
+    out = []
+    for node in tree:
+        entry = {"name": node.get("name"), "age_sec": node.get("age_sec"),
+                 "depth": depth}
+        if node.get("attrs"):
+            entry["attrs"] = node["attrs"]
+        out.append(entry)
+        out.extend(_flatten_inflight(node.get("children", []), depth + 1))
+    return out
+
+
+def report(doc: dict, source: str) -> dict:
+    out: dict = {"metric": "statusz_report", "source": source}
+    if doc.get("kind") == "demodel-flight-recorder":
+        spans = doc.get("spans", [])
+        out.update({
+            "kind": "flight-recorder",
+            "reason": doc.get("reason"),
+            "pid": doc.get("pid"),
+            "spans": len(spans),
+            "dropped": doc.get("dropped", 0),
+            "errors": [
+                {"name": r["name"], "error": r.get("error", ""),
+                 "secs": r.get("dur", 0.0)}
+                for r in spans if r.get("status") == "error"],
+            "stages": stage_breakdown(spans),
+            "inflight": _flatten_inflight(
+                nest_spans(doc.get("inflight", []))),
+        })
+        return out
+    if "statusz" not in doc:
+        raise SystemExit(f"{source}: neither a statusz document nor a "
+                         "flight-recorder dump")
+    out["kind"] = "statusz"
+    out["server"] = doc.get("server")
+    out["uptime_sec"] = doc.get("uptime_sec")
+    breakers = doc.get("breakers", {})
+    out["breakers_open"] = [
+        {"peer": peer, **b} for peer, b in sorted(breakers.items())
+        if b.get("state") != "closed"]
+    out["breakers_total"] = len(breakers)
+    out["inflight"] = _flatten_inflight(doc.get("inflight_spans", []))
+    budgets = doc.get("budgets", [])
+    if budgets:
+        out["budgets"] = budgets
+    if "conns" in doc:  # the native proxy's section
+        out["conns"] = doc["conns"]
+    if "trace" in doc:
+        out["trace"] = doc["trace"]
+    return out
+
+
+def validate(doc: dict, source: str) -> None:
+    """Schema gate for CI: the fields every consumer of this surface
+    depends on must exist with the right shapes."""
+    if doc.get("kind") == "demodel-flight-recorder":
+        for key in ("reason", "ts", "pid", "spans", "inflight"):
+            if key not in doc:
+                raise SystemExit(f"{source}: recorder dump missing {key!r}")
+        return
+    if doc.get("statusz") != 1:
+        raise SystemExit(f"{source}: missing/unknown statusz schema version")
+    native = doc.get("server") == "demodel-native-proxy"
+    required = (("config", "conns", "metrics") if native else
+                ("breakers", "budgets", "inflight_spans", "trace"))
+    for key in required:
+        if key not in doc:
+            raise SystemExit(f"{source}: statusz missing {key!r}")
+    if native and "hist" not in doc["metrics"]:
+        raise SystemExit(f"{source}: native metrics missing histograms")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("source", help="statusz URL (http://host:port) or "
+                                   "flight-recorder dump path")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-check only (CI smoke); nonzero on failure")
+    args = ap.parse_args(argv)
+
+    doc, source = load(args.source)
+    if args.validate:
+        validate(doc, source)
+        print(json.dumps({"metric": "statusz_validate", "source": source,
+                          "ok": True}))
+        return 0
+    print(json.dumps(report(doc, source), default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
