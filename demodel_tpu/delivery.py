@@ -132,9 +132,13 @@ def pull_to_hbm(
     if peers is None:
         peers = [p for p in os.environ.get("DEMODEL_PEERS", "").split(",") if p.strip()]
     if peers:
-        from demodel_tpu.parallel.peer import PeerSet
+        from demodel_tpu.parallel.peer import PeerGossip, PeerSet
 
         peer_set = PeerSet(peers)
+        # enroll the peer set for background index refresh: this pull's
+        # locate calls (and every later pull's rotation build) answer
+        # from gossiped possession data instead of per-pull probe rounds
+        PeerGossip.shared().track(peers)
     sink_worker = None
     handed_off = False  # True once the background finalizer owns flush+close
     profile_dir = os.environ.get("DEMODEL_PROFILE_DIR", "").strip()

@@ -19,9 +19,11 @@ import re
 import threading
 import time
 from dataclasses import dataclass, field
+from typing import ClassVar
 
 import requests
 
+from demodel_tpu.parallel.placement import HashRing
 from demodel_tpu.store import Store
 from demodel_tpu.utils import trace
 from demodel_tpu.utils.env import env_int
@@ -34,6 +36,176 @@ from demodel_tpu.utils.faults import (
 from demodel_tpu.utils.logging import get_logger
 
 log = get_logger("peer")
+
+
+class PeerGossip:
+    """Process-wide, versioned possession index over the peer set.
+
+    Two feeds, one consumer contract:
+
+    - **piggyback**: every ``/peer/index`` download anywhere in the
+      process (:meth:`PeerSet.index`) is observed here for free — locate
+      calls and striping rotations read the freshest answer any
+      component already paid for;
+    - **background refresh**: peers enrolled via :meth:`track` are
+      re-polled every ``DEMODEL_SWARM_INDEX_REFRESH_S`` seconds off the
+      critical path, replacing the old per-pull probe round — pull #2
+      onward builds its rotation with zero liveness traffic.
+
+    Entries are versioned (monotonic per peer) and bounded
+    (``DEMODEL_SWARM_INDEX_KEYS`` keys per peer, newest fetch wins);
+    deliberately NOT fed into the breakers — gossip is advisory
+    liveness, and a background poller must never burn a breaker's
+    half-open probe slot or open breakers behind a live pull's back.
+    """
+
+    _shared: ClassVar["PeerGossip | None"] = None
+    _shared_lock: ClassVar[threading.Lock] = threading.Lock()
+
+    def __init__(self, refresh_s: float | None = None,
+                 max_keys: int | None = None):
+        self.refresh_s = refresh_s if refresh_s is not None else float(
+            env_int("DEMODEL_SWARM_INDEX_REFRESH_S", 2, minimum=1))
+        self.max_keys = max_keys if max_keys is not None else env_int(
+            "DEMODEL_SWARM_INDEX_KEYS", 65536, minimum=16)
+        self._lock = threading.Lock()
+        #: peer → (version, keys-or-None, monotonic ts, ok)
+        self._entries: dict[str, tuple[int, frozenset | None, float, bool]] = {}
+        self._tracked: set[str] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @classmethod
+    def shared(cls) -> "PeerGossip":
+        with cls._shared_lock:
+            if cls._shared is None:
+                cls._shared = cls()
+            return cls._shared
+
+    @classmethod
+    def reset_shared(cls) -> None:
+        """Drop the process-wide registry, stopping its refresher
+        (tests only)."""
+        with cls._shared_lock:
+            inst, cls._shared = cls._shared, None
+        if inst is not None:
+            inst.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+
+    # -- feeds -----------------------------------------------------------
+    def observe(self, peer: str, keys: set[str] | None,
+                ok: bool = True) -> None:
+        """Merge one index outcome (a real download or a failed one).
+        ``keys=None`` with ``ok=False`` records liveness without data."""
+        peer = peer.rstrip("/")
+        frozen = None
+        if keys is not None:
+            if len(keys) > self.max_keys:
+                # bounded: keep a deterministic subset — membership tests
+                # may false-miss, and the locate fallback covers that
+                frozen = frozenset(sorted(keys)[: self.max_keys])
+            else:
+                frozen = frozenset(keys)
+        with self._lock:
+            version = self._entries.get(peer, (0,))[0] + 1
+            self._entries[peer] = (version, frozen, time.monotonic(), ok)
+
+    def track(self, peers: list) -> None:
+        """Enroll peers for background refresh (idempotent; starts the
+        refresher thread on first use)."""
+        cleaned = {p.rstrip("/") for p in peers if p}
+        if not cleaned:
+            return
+        with self._lock:
+            self._tracked |= cleaned
+            start = self._thread is None and not self._stop.is_set()
+            if start:
+                self._thread = threading.Thread(
+                    target=self._refresh_loop, name="peer-gossip",
+                    daemon=True)
+        if start:
+            self._thread.start()
+
+    # -- reads -----------------------------------------------------------
+    def _fresh(self, peer: str,
+               max_age: float) -> tuple[frozenset | None, bool] | None:
+        with self._lock:
+            e = self._entries.get(peer.rstrip("/"))
+        if e is None or time.monotonic() - e[2] > max_age:
+            return None
+        return e[1], e[3]
+
+    def keys(self, peer: str, max_age: float | None = None) -> frozenset | None:
+        """Fresh possession set for ``peer``, or None when gossip has
+        nothing current (caller falls back to a real index fetch)."""
+        age = max_age if max_age is not None else 3 * self.refresh_s
+        e = self._fresh(peer, age)
+        if e is None:
+            return None
+        ks, ok = e
+        return ks if ok else None
+
+    def split(self, peers: list, max_age: float | None = None
+              ) -> tuple[list, list, list]:
+        """``(alive, dead, unknown)`` partition of ``peers`` by gossip
+        freshness — the replacement for the per-pull probe round: only
+        ``unknown`` (never-heard-from) peers still need a real probe."""
+        age = max_age if max_age is not None else 3 * self.refresh_s
+        alive: list = []
+        dead: list = []
+        unknown: list = []
+        for p in peers:
+            e = self._fresh(p, age)
+            if e is None:
+                unknown.append(p)
+            elif e[1]:
+                alive.append(p)
+            else:
+                dead.append(p)
+        return alive, dead, unknown
+
+    def describe(self) -> dict[str, dict]:
+        """Statusz view: per-peer freshness, never the key sets."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                peer: {"version": v, "keys": len(k) if k is not None else 0,
+                       "age_sec": round(now - ts, 3), "ok": ok}
+                for peer, (v, k, ts, ok) in sorted(self._entries.items())
+            }
+
+    # -- refresher -------------------------------------------------------
+    def _refresh_loop(self) -> None:
+        session = requests.Session()
+        while not self._stop.wait(self.refresh_s):
+            with self._lock:
+                peers = sorted(self._tracked)
+            for peer in peers:
+                if self._stop.is_set():
+                    return
+                self._refresh_one(session, peer)
+
+    def _refresh_one(self, session: requests.Session, peer: str) -> None:
+        # span-free, single attempt: a background refresh failing against
+        # a dead peer is routine liveness data (observe ok=False), not an
+        # incident — it must not trip the flight recorder's error-root
+        # dump, and the next refresh tick is the retry
+        try:
+            r = session.get(f"{peer}/peer/index", timeout=5.0)
+            r.raise_for_status()
+            body = r.json()
+            entries = body.get("keys", ()) if isinstance(body, dict) else ()
+            keys = {str(e["key"]) for e in entries
+                    if isinstance(e, dict) and "key" in e}
+            self.observe(peer, keys, ok=True)
+        except (requests.RequestException, OSError, ValueError,
+                TypeError):
+            self.observe(peer, None, ok=False)
 
 
 def _peer_streams() -> int:
@@ -79,6 +251,7 @@ class PeerSet:
         self.index_ttl = index_ttl
         self._tls = threading.local()
         self._lock = threading.Lock()
+        self._ring_cache: HashRing | None = None
         self._index_cache: dict[str, tuple[set[str], float]] = {}
         #: serializes the index *download* per peer so a cold-cache fan-out
         #: of fetch workers doesn't stampede /peer/index N times at once
@@ -128,19 +301,51 @@ class PeerSet:
                 keys = {str(e["key"]): str(e.get("sha256") or "")
                         for e in entries
                         if isinstance(e, dict) and "key" in e}
+                PeerGossip.shared().observe(peer, set(keys))
             except (requests.RequestException, ValueError, TypeError) as e:
                 log.warning("peer %s index failed: %s", peer, e)
                 keys = {}
+                PeerGossip.shared().observe(peer, None, ok=False)
             with self._lock:
                 self._index_cache[peer] = (keys, time.monotonic())
             return keys
 
+    def _ring(self) -> HashRing:
+        """Consistent-hash ring over this peer set (built once): the
+        same ring the striping rotation places files with, so the owner
+        computed here is the peer most likely to hold the key."""
+        ring = self._ring_cache
+        if ring is None:
+            ring = self._ring_cache = HashRing(self.peers)
+        return ring
+
     def locate(self, key: str) -> str | None:
-        """First breaker-admitted peer advertising ``key`` (index
-        refreshed on miss). Open-breaker peers are skipped until their
-        half-open probe succeeds — a dead friend must not cost every
-        lookup a connect timeout; the upstream fallback covers the gap."""
+        """Peer advertising ``key``, ring-first: the consistent-hash
+        owner (and its successor) answer from gossip or the cached index
+        without any broadcast — matching how the striping rotation
+        placed the key — and only a ring miss falls back to the full
+        probe scan. Open-breaker peers are skipped until their half-open
+        probe succeeds — a dead friend must not cost every lookup a
+        connect timeout; the upstream fallback covers the gap."""
         with trace.span("peer-locate", key=key) as sp:
+            gossip = PeerGossip.shared()
+            ring_owners = self._ring().owners(key, 2)
+            for peer in ring_owners:
+                if not self._health.admissible(peer):
+                    continue
+                known = gossip.keys(peer)
+                if known is not None:
+                    # fresh gossip answers without a dial either way; a
+                    # stale "no" is caught by the refresh scan below
+                    if key in known:
+                        sp.set_attr("peer", peer)
+                        sp.set_attr("via", "ring-gossip")
+                        return peer
+                    continue
+                if key in self.index(peer):
+                    sp.set_attr("peer", peer)
+                    sp.set_attr("via", "ring-index")
+                    return peer
             for refresh in (False, True):
                 for peer in self.peers:
                     if not self._health.admissible(peer):

@@ -34,6 +34,19 @@ from demodel_tpu.utils.logging import get_logger
 log = get_logger("restore")
 
 
+def _swarm_board(pull_id: str, host_id: str):
+    """Resolve a swarm chunk board WITHOUT importing the swarm plane: a
+    board can only exist if this process runs a :class:`SwarmScheduler`
+    (which imports the placement module) — a dep-light restore node that
+    never swarms answers 404 and never pays the import."""
+    import sys
+
+    placement = sys.modules.get("demodel_tpu.parallel.placement")
+    if placement is None:
+        return None
+    return placement.board(pull_id, host_id)
+
+
 @dataclass(frozen=True)
 class _TensorLoc:
     key: str      # store key of the safetensors blob
@@ -463,6 +476,27 @@ def make_handler(registry: RestoreRegistry, proxy=None):
                 return
             if self.path == "/restore/models":
                 self._send(200, json.dumps({"models": registry.models()}).encode())
+                return
+            m = re.match(r"^/swarm/([^/]+)/([^/]+)/chunks$", self.path)
+            if m:
+                board = _swarm_board(m.group(1), m.group(2))
+                if board is None:
+                    self._send(404, b'{"error":"no such swarm board"}')
+                    return
+                self._send(200, json.dumps(board.summary()).encode())
+                return
+            m = re.match(r"^/swarm/([^/]+)/([^/]+)/chunk/([^/]+)/(\d+)$",
+                         self.path)
+            if m:
+                board = _swarm_board(m.group(1), m.group(2))
+                data = board.get(m.group(3), int(m.group(4))) \
+                    if board is not None else None
+                if data is None:
+                    self._send(404, b'{"error":"chunk not held"}')
+                    return
+                metrics.HUB.inc("swarm_chunks_served_total")
+                metrics.HUB.inc("swarm_bytes_served_total", len(data))
+                self._send(200, data, ctype="application/octet-stream")
                 return
             m = re.match(r"^/restore/blob/([0-9a-f]{64})$", self.path)
             if m:

@@ -36,9 +36,19 @@ import numpy as np
 import requests
 
 from demodel_tpu.delivery import manifest_key
+from demodel_tpu.parallel import placement as swarm_placement
+from demodel_tpu.parallel.placement import (
+    ChunkBoard,
+    HashRing,
+    bitmap_indices,
+    bounded_assign,
+    chunk_count,
+    chunk_span,
+    default_chunk_bytes,
+)
 from demodel_tpu.sink.hbm import Placement, is_weight_file, merge_placement
 from demodel_tpu.sink.plan import ShardingPlan
-from demodel_tpu.utils import trace
+from demodel_tpu.utils import metrics, trace
 from demodel_tpu.utils.env import env_int
 from demodel_tpu.utils.faults import (
     PeerHealth,
@@ -488,6 +498,27 @@ def _alive_peers_threaded(peers: list, timeout: float = 3.0) -> list:
         ex.shutdown(wait=False, cancel_futures=True)
 
 
+def _responsive_peers(peers: list, timeout: float = 3.0) -> list:
+    """The striping-rotation membership check, gossip-first: peers whose
+    background index refresh (:class:`~demodel_tpu.parallel.peer
+    .PeerGossip`) answered recently join with ZERO wire traffic on the
+    pull critical path, fresh-failed peers drop out, and only peers the
+    gossip has never heard from fall back to the one-shot concurrent
+    probe round (the cold-start shape). Every pull also enrolls its
+    peers for background refresh, so pull #2 onward probes nothing."""
+    if not peers:
+        return []
+    from demodel_tpu.parallel.peer import PeerGossip
+
+    gossip = PeerGossip.shared()
+    gossip.track(peers)
+    alive, dead, unknown = gossip.split(peers)
+    if dead:
+        log.info("striping rotation drops %d gossip-dead peer(s)",
+                 len(dead))
+    return alive + (_alive_peers(unknown, timeout) if unknown else [])
+
+
 def _reader_and_index(f: dict, peer_order: list[str], streams):
     """Open ``f`` on the first peer that can serve its safetensors index
     (header reads fail over peer-by-peer here; window reads during
@@ -515,6 +546,602 @@ def _reader_and_index(f: dict, peer_order: list[str], streams):
             log.warning("index of %s from %s failed (%s); trying next "
                         "peer", f["name"], source_peer, e)
     raise IOError(f"no peer could serve {f['name']}") from last_err
+
+
+# --------------------------------------------------------------- swarm fetch
+#
+# Pod-scale cold pull: N hosts pulling the same manifest partition every
+# file's fixed chunk grid over a consistent-hash ring (disjoint origin
+# chunk sets), fetch ONLY their owned chunks from origin, and cross-fill
+# the rest from each other as possession advertisements land — aggregate
+# origin traffic ≈ 1× the manifest, origin-bound wall-clock ≈ size/N.
+# The per-chunk transport is the existing window machinery
+# (PeerBlobReader.pread_into: resume-at-offset, breaker-gated failover),
+# so WindowAbort semantics hold inside every chunk.
+
+
+def _swarm_chunk_id(key: str, index: int) -> str:
+    return f"{key}:{index}"
+
+
+def _swarm_origin_read(reader: PeerBlobReader, key: str, offset: int,
+                       length: int) -> bytes:
+    """THE origin transport of the swarm plane: one owned (or re-owned)
+    chunk off the origin/warm-peer rotation. Every origin byte a swarm
+    pull moves goes through here — the ``swarm-owner-only-origin``
+    analyzer rule keeps callers inside :class:`SwarmScheduler`, where the
+    ownership decision lives, so no code path can quietly degrade the
+    aggregate-origin-bytes ≈ 1× contract back into N× origin pulls."""
+    buf = bytearray(length)
+    with trace.span("chunk-origin", key=key, offset=offset, bytes=length):
+        reader.pread_into(key, buf, offset)
+    metrics.HUB.inc("swarm_origin_bytes_total", length)
+    return bytes(buf)
+
+
+class SwarmScheduler:
+    """Chunk-level swarm fetch for one pull on one host.
+
+    ``participants``: ``{host_id: base_url}`` of every host in the swarm
+    (including this one — ``self_id`` selects which). All hosts build the
+    same :class:`HashRing` over the sorted host ids, so chunk ownership
+    needs no coordination traffic at all.
+
+    Three background roles run between :meth:`start` and :meth:`close`:
+
+    - the **origin pump** fetches this host's owned chunks from the
+      origin rotation, rarest-first-ish (fewest known advertisers, hash
+      tie-break — hosts' request orders decorrelate, so the swarm's
+      earliest cross-fills spread over the whole grid);
+    - the **gossip poller** refreshes every sibling's possession bitmap
+      (``/swarm/{pull}/{host}/chunks``) and declares siblings dead after
+      consecutive poll failures;
+    - **fill workers** pull advertised non-owned chunks from whichever
+      sibling has them (``chunk-peer-fill``), landing them on the local
+      :class:`ChunkBoard` — which the restore server re-serves, so a
+      chunk crosses origin once and then propagates peer-to-peer.
+
+    Death handling is succession, not re-pull: a dead owner's chunk is
+    re-owned by the next live host on its ring arc; only that successor
+    goes back to origin (counted in ``swarm_chunks_refetched_total``),
+    everyone else cross-fills from the successor.
+    """
+
+    def __init__(self, pull_id: str, self_id: str,
+                 participants: dict[str, str],
+                 chunk_bytes: int | None = None,
+                 health: PeerHealth | None = None,
+                 policy: RetryPolicy | None = None):
+        if self_id not in participants:
+            raise ValueError(f"self_id {self_id!r} not in participants")
+        self.pull_id = pull_id
+        self.self_id = self_id
+        self.participants = dict(participants)
+        self.chunk_bytes = chunk_bytes or default_chunk_bytes()
+        self.ring = HashRing(sorted(participants))
+        self.board = ChunkBoard(pull_id, self_id)
+        self._health = health if health is not None else PeerHealth.shared()
+        self._policy = policy if policy is not None else RetryPolicy()
+        #: per-owner wait before a chunk succeeds to the next ring host.
+        #: Sized for a live-but-busy owner, not a dead one (death is
+        #: detected in ~3 gossip ticks): on a big manifest the LAST
+        #: chunk of an owner's rarest-first queue legitimately takes its
+        #: whole owned share's origin time to appear, so a small value
+        #: here re-fetches healthy hosts' chunks and erodes the 1×
+        #: origin contract
+        self._fill_timeout = float(env_int(
+            "DEMODEL_SWARM_FILL_TIMEOUT", 60, minimum=1))
+        self._gossip_s = env_int(
+            "DEMODEL_SWARM_GOSSIP_MS", 500, minimum=10) / 1000.0
+        self._fill_streams = env_int(
+            "DEMODEL_SWARM_FILL_STREAMS", 4, minimum=1)
+        #: concurrent origin CONNECTIONS per host (the pump + any
+        #: ensure-inline re-own fetch share it): the disjoint-chunk-set
+        #: contract bounds each host's origin LINK use, so the default
+        #: is one stream — multi-stream parallelism belongs inside a
+        #: window (DEMODEL_PEER_STREAMS), not across origin chunks
+        self._origin_sem = threading.Semaphore(env_int(
+            "DEMODEL_SWARM_ORIGIN_STREAMS", 1, minimum=1))
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        #: file key → (size, n_chunks, origin PeerBlobReader)
+        self._files: dict[str, tuple[int, int, PeerBlobReader]] = {}
+        self._primary: dict[tuple[str, int], str] = {}
+        self._owned: list[tuple[str, int]] = []
+        self._inflight: set[tuple[str, int]] = set()
+        self._peer_have: dict[str, dict[str, set[int]]] = {}
+        self._peer_ver: dict[str, int] = {}
+        self._poll_fails: dict[str, int] = {}
+        self._dead: set[str] = set()
+        self._peer_bytes: dict[str, int] = {}   # file key → peer-fill bytes
+        self._spread: dict[tuple[str, int], int] = {}  # rarest tie-break
+        self.chunks_refetched = 0
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._tls = threading.local()
+        swarm_placement.register_board(self.board)
+
+    # -- planning --------------------------------------------------------
+    def add_file(self, key: str, size: int,
+                 origin_reader: PeerBlobReader) -> None:
+        """Register one manifest file's chunk grid (call for every
+        weight file BEFORE start — ownership is assigned over the WHOLE
+        grid at once so the capacity bound balances across files)."""
+        if self._threads:
+            raise RuntimeError("add_file after start(): the ownership "
+                               "assignment is already fixed")
+        n = chunk_count(size, self.chunk_bytes)
+        with self._lock:
+            self._files[key] = (int(size), n, origin_reader)
+            self._peer_bytes.setdefault(key, 0)
+        self.board.add_file(key, n)
+
+    def _plan(self) -> None:
+        """The ownership decision for the whole grid: ring succession
+        for agreement + death recovery, bounded loads for balance (the
+        swarm's wall-clock is the LARGEST owned share's origin time)."""
+        with self._lock:
+            grid = [(k, i) for k, (_s, n, _r) in sorted(self._files.items())
+                    for i in range(n)]
+        with trace.span("swarm-schedule", chunks=len(grid),
+                        files=len(self._files),
+                        hosts=len(self.participants)) as sp:
+            assigned = bounded_assign(
+                self.ring, [_swarm_chunk_id(k, i) for k, i in grid])
+            with self._lock:
+                self._primary = {
+                    (k, i): assigned[_swarm_chunk_id(k, i)]
+                    for k, i in grid}
+                self._owned = [c for c, owner in self._primary.items()
+                               if owner == self.self_id]
+            sp.set_attr("owned", len(self._owned))
+
+    def start(self) -> "SwarmScheduler":
+        if self._threads:
+            return self
+        self._plan()
+        self._threads.append(threading.Thread(
+            target=self._pump_origin, name="swarm-pump", daemon=True))
+        if len(self.participants) > 1:
+            self._threads.append(threading.Thread(
+                target=self._pump_gossip, name="swarm-gossip", daemon=True))
+            for i in range(self._fill_streams):
+                self._threads.append(threading.Thread(
+                    target=self._pump_fill, name=f"swarm-fill-{i}",
+                    daemon=True))
+        for t in self._threads:
+            t.start()
+        return self
+
+    def close(self) -> None:
+        """Stop the pumps, free the board, unregister the serve surface.
+        The caller decides WHEN: closing before every sibling has the
+        bytes pushes the swarm's stragglers back to origin."""
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=30)
+        self._threads.clear()
+        swarm_placement.unregister_board(self.board)
+        self.board.clear()
+
+    # -- read surface ----------------------------------------------------
+    def peer_bytes_for(self, key: str) -> int:
+        with self._lock:
+            return self._peer_bytes.get(key, 0)
+
+    def read_into(self, key: str, view: memoryview, offset: int) -> int:
+        """Copy ``[offset, offset+len(view))`` of ``key`` out of the
+        board, blocking per covering chunk until the swarm lands it."""
+        with self._lock:
+            size, _n, _r = self._files[key]
+        length = view.nbytes
+        if offset < 0 or offset + length > size:
+            raise IOError(f"swarm window [{offset}, {offset + length}) "
+                          f"outside {key} of {size} bytes")
+        pos = 0
+        while pos < length:
+            idx = (offset + pos) // self.chunk_bytes
+            c_off, c_len = chunk_span(size, self.chunk_bytes, idx)
+            data = self.ensure(key, idx)
+            lo = offset + pos - c_off
+            take = min(c_len - lo, length - pos)
+            view[pos:pos + take] = data[lo:lo + take]
+            pos += take
+        return length
+
+    def fetch_all(self) -> None:
+        """Block until EVERY chunk of every registered file is on the
+        board — swarm participation for a host that isn't also delivering
+        to HBM (bench hosts, warm standbys)."""
+        with self._lock:
+            grid = [(k, i) for k, (_s, n, _r) in sorted(self._files.items())
+                    for i in range(n)]
+        for key, idx in grid:
+            self.ensure(key, idx)
+
+    # -- chunk acquisition ----------------------------------------------
+    def ensure(self, key: str, index: int) -> bytes:
+        """The ownership decision: return chunk bytes, sourcing them per
+        the assignment — owned → origin; non-owned → wait for the
+        owner's advertisement and cross-fill; owner dead/stuck →
+        succession along the raw ring order, where only the next live
+        host re-sources from origin."""
+        chunk_id = _swarm_chunk_id(key, index)
+        with self._lock:
+            primary = self._primary.get((key, index))
+        if primary is None:
+            raise RuntimeError("ensure() before start(): no ownership "
+                               "assignment yet")
+        owners = [primary] + [
+            o for o in self.ring.owners(chunk_id, len(self.participants))
+            if o != primary]
+        waited_since: dict[str, float] = {}
+        while not self._stop.is_set():
+            data = self.board.get(key, index)
+            if data is not None:
+                return data
+            live = [o for o in owners if o not in self._snapshot_dead()]
+            target = live[0] if live else self.self_id
+            if target == self.self_id:
+                self._fetch_origin(key, index,
+                                   reowned=(owners[0] != self.self_id))
+                continue
+            # a sibling owns it: grab it the moment an advertiser shows
+            # (ANY advertiser — cross-filled copies count), else wait
+            adv = self._advertisers(key, index)
+            if adv:
+                if self._fetch_peer(key, index, adv):
+                    continue
+            now = time.monotonic()
+            waited_since.setdefault(target, now)
+            if now - waited_since[target] > self._fill_timeout:
+                # the live owner never produced the chunk (wedged, not
+                # dead-dialed): succession treats it as gone
+                with self._lock:
+                    self._dead.add(target)
+                    self._cv.notify_all()
+                log.warning(
+                    "swarm owner %s never advertised chunk %s/%d within "
+                    "%.0fs; treating it as dead (succession)", target,
+                    key, index, self._fill_timeout)
+                # its other orphans join our pump where we're successor
+                self._take_over_orphans()
+                continue
+            with self._cv:
+                self._cv.wait(timeout=min(0.2, self._gossip_s))
+        raise IOError(f"swarm pull {self.pull_id} closed while waiting "
+                      f"for chunk {key}/{index}")
+
+    def _snapshot_dead(self) -> set[str]:
+        with self._lock:
+            return set(self._dead)
+
+    def _advertisers(self, key: str, index: int) -> list[str]:
+        with self._lock:
+            return [h for h, files in self._peer_have.items()
+                    if h not in self._dead and index in files.get(key, ())]
+
+    def _claim(self, key: str, index: int) -> bool:
+        with self._lock:
+            if (key, index) in self._inflight \
+                    or self.board.has(key, index):
+                return False
+            self._inflight.add((key, index))
+            return True
+
+    def _release(self, key: str, index: int) -> None:
+        with self._cv:
+            self._inflight.discard((key, index))
+            self._cv.notify_all()
+
+    def _fetch_origin(self, key: str, index: int,
+                      reowned: bool = False) -> None:
+        if not self._claim(key, index):
+            # someone else is on it — wait for their outcome
+            with self._cv:
+                self._cv.wait(timeout=0.2)
+            return
+        try:
+            with self._lock:
+                size, _n, reader = self._files[key]
+            off, ln = chunk_span(size, self.chunk_bytes, index)
+            with self._origin_sem:
+                data = _swarm_origin_read(reader, key, off, ln)
+            if reowned:
+                with self._lock:
+                    self.chunks_refetched += 1
+                metrics.HUB.inc("swarm_chunks_refetched_total")
+                log.info("swarm re-owned chunk %s/%d from origin "
+                         "(owner dead)", key, index)
+            self.board.put(key, index, data)
+        finally:
+            self._release(key, index)
+
+    def _session(self) -> requests.Session:
+        s = getattr(self._tls, "session", None)
+        if s is None:
+            s = self._tls.session = requests.Session()
+        return s
+
+    def _fetch_peer(self, key: str, index: int,
+                    advertisers: list[str]) -> bool:
+        """One cross-fill attempt off the best advertiser (ring owner
+        first). Returns True when the chunk landed (or someone else's
+        fetch is in flight — the caller re-checks the board)."""
+        if not self._claim(key, index):
+            return True
+        chunk_id = _swarm_chunk_id(key, index)
+        order = [o for o in self.ring.owners(chunk_id,
+                                             len(self.participants))
+                 if o in advertisers] or advertisers
+        try:
+            with self._lock:
+                size, _n, _r = self._files[key]
+            _off, ln = chunk_span(size, self.chunk_bytes, index)
+            for host in order:
+                url = self.participants[host]
+                try:
+                    with trace.span("chunk-peer-fill", key=key,
+                                    index=index, peer=host, bytes=ln):
+                        r = request_with_retry(
+                            self._session(), "GET",
+                            f"{url}/swarm/{self.pull_id}/{host}"
+                            f"/chunk/{key}/{index}",
+                            policy=RetryPolicy(max_attempts=2,
+                                               deadline=30.0),
+                            health=self._health, peer=url.rstrip("/"),
+                            timeout=30.0,
+                            what=f"swarm chunk {key}/{index} from {host}")
+                    if len(r.content) != ln:
+                        raise TruncatedBody(
+                            f"swarm chunk {key}/{index}: "
+                            f"{len(r.content)} != {ln}")
+                    metrics.HUB.inc("swarm_peer_bytes_total", ln)
+                    with self._lock:
+                        self._peer_bytes[key] = \
+                            self._peer_bytes.get(key, 0) + ln
+                    self.board.put(key, index, r.content)
+                    return True
+                except (requests.RequestException, WireError, OSError) as e:
+                    log.warning("swarm fill of %s/%d from %s failed: %s",
+                                key, index, host, e)
+                    self._poll_failed(host)
+            return False
+        finally:
+            self._release(key, index)
+
+    # -- background pumps ------------------------------------------------
+    def _pump_origin(self) -> None:
+        """Owned chunks off origin, rarest-first-ish: among the remaining
+        owned set, always the chunk the fewest siblings advertise (hash
+        tie-break decorrelates hosts) — the swarm's rarest pieces cross
+        origin earliest, classic BitTorrent scheduling. Runs until
+        close(): succession can grow the owned set at any time
+        (_take_over_orphans), so an idle pump parks on the cv instead of
+        exiting."""
+        while not self._stop.is_set():
+            with self._lock:
+                remaining = [c for c in self._owned
+                             if c not in self._inflight
+                             and not self.board.has(*c)]
+                # one possession snapshot per pick, not one lock-held
+                # _advertisers() scan per candidate: a 13 GB manifest is
+                # ~1700 owned chunks on a solo host and re-scoring the
+                # whole remainder under the scheduler lock every fetch
+                # contends with ensure()/fill workers for the pull's
+                # entire duration
+                peer_have = {h: files
+                             for h, files in self._peer_have.items()
+                             if h not in self._dead}
+            if not remaining:
+                with self._cv:
+                    self._cv.wait(timeout=0.5)
+                continue
+
+            def rarity(c: tuple[str, int]) -> tuple[int, int]:
+                sk = self._spread.get(c)
+                if sk is None:
+                    sk = self._spread[c] = swarm_placement.spread_key(
+                        _swarm_chunk_id(*c))
+                n = sum(1 for files in peer_have.values()
+                        if c[1] in files.get(c[0], ()))
+                return (n, sk)
+
+            key, index = min(remaining, key=rarity)
+            with self._lock:
+                reowned = self._primary.get((key, index)) != self.self_id
+            try:
+                self._fetch_origin(key, index, reowned=reowned)
+            except IOError as e:
+                log.warning("swarm origin fetch of %s/%d failed: %s "
+                            "(will retry / re-ensure on demand)",
+                            key, index, e)
+                with self._cv:
+                    self._cv.wait(timeout=0.5)
+
+    def _pump_gossip(self) -> None:
+        # dead hosts stay in the poll rotation: death is a ROUTING
+        # verdict (stop waiting on it, succession takes its chunks), not
+        # a ban — a wedged-then-recovered or restarted sibling re-enters
+        # on its first successful poll (merge_summary resurrects it)
+        siblings = [h for h in self.participants if h != self.self_id]
+        while not self._stop.is_set():
+            for host in siblings:
+                if self._stop.is_set():
+                    return
+                self._poll_one(host)
+            self._stop.wait(self._gossip_s)
+
+    def _poll_one(self, host: str) -> None:
+        # deliberately span-free and single-attempt (a raw session.get,
+        # not request_with_retry): a background poll failing against a
+        # dead sibling is ROUTINE — it must not become an error-status
+        # root span that trips the flight recorder's incident dump, and
+        # the next poll tick IS the retry
+        url = self.participants[host]
+        try:
+            r = self._session().get(
+                f"{url}/swarm/{self.pull_id}/{host}/chunks", timeout=5.0)
+            r.raise_for_status()
+            self.merge_summary(host, r.json())
+        except (requests.RequestException, OSError, ValueError,
+                TypeError):
+            self._poll_failed(host)
+
+    def merge_summary(self, host: str, summary: dict) -> None:
+        """Versioned merge of one sibling's possession bitmap (also fed
+        by tests/bench driving in-process boards directly)."""
+        if not isinstance(summary, dict):
+            return
+        try:
+            version = int(summary.get("v", 0))
+            files = summary.get("files", {})
+            have = {
+                str(k): bitmap_indices(str(spec.get("have", "")),
+                                       int(spec.get("n", 0)))
+                for k, spec in files.items() if isinstance(spec, dict)
+            }
+        except (TypeError, ValueError, AttributeError):
+            return  # junk gossip degrades to nothing, never a crash
+        with self._cv:
+            # a DEAD host's successful poll always wins: a restarted
+            # sibling's board restarts its version counter near zero, so
+            # holding it to the old high-water mark would veto the very
+            # resurrection _pump_gossip promises
+            if host not in self._dead \
+                    and version < self._peer_ver.get(host, -1):
+                return  # stale reordering
+            self._peer_ver[host] = version
+            self._peer_have[host] = have
+            self._poll_fails[host] = 0
+            if host in self._dead:
+                # resurrection: chunks already taken over stay ours
+                # (board dedupe makes the overlap at most one extra
+                # origin chunk each), but the host serves cross-fills
+                # and keeps its not-yet-orphaned chunks again
+                self._dead.discard(host)
+                log.info("swarm sibling %s resurrected (gossip poll "
+                         "succeeded)", host)
+            self._cv.notify_all()
+
+    def _poll_failed(self, host: str) -> None:
+        died = False
+        with self._cv:
+            fails = self._poll_fails.get(host, 0) + 1
+            self._poll_fails[host] = fails
+            if fails >= 3 and host not in self._dead:
+                self._dead.add(host)
+                died = True
+                log.warning("swarm sibling %s declared dead after %d "
+                            "straight failures; its chunks re-own via "
+                            "ring succession", host, fails)
+            self._cv.notify_all()
+        if died:
+            self._take_over_orphans()
+
+    def _take_over_orphans(self) -> None:
+        """Proactive succession: chunks whose primary is dead and whose
+        first LIVE ring successor is this host join the origin pump now
+        — a waiting sibling cross-fills from us instead of timing out
+        into its own origin fetch (which would double-move the bytes)."""
+        with self._cv:
+            dead = set(self._dead)
+            mine = set(self._owned)
+            takeover = []
+            for (key, idx), primary in self._primary.items():
+                if primary not in dead or (key, idx) in mine:
+                    continue
+                chunk_id = _swarm_chunk_id(key, idx)
+                live = [o for o in self.ring.owners(
+                            chunk_id, len(self.participants))
+                        if o == self.self_id or o not in dead]
+                if live and live[0] == self.self_id:
+                    takeover.append((key, idx))
+            if not takeover:
+                return
+            self._owned.extend(takeover)
+            self._cv.notify_all()
+        log.info("swarm succession: taking over %d orphaned chunk(s) "
+                 "from dead sibling(s) %s", len(takeover), sorted(dead))
+
+    def _pump_fill(self) -> None:
+        """Cross-fill any advertised, non-local, non-owned chunk — the
+        keep-the-pipe-full role; ensure() only ever waits for chunks the
+        pumps haven't reached yet."""
+        while not self._stop.is_set():
+            target = None
+            with self._lock:
+                for host, files in self._peer_have.items():
+                    if host in self._dead:
+                        continue
+                    for key, idxs in files.items():
+                        if key not in self._files:
+                            continue
+                        for i in sorted(idxs):
+                            if (key, i) not in self._inflight \
+                                    and not self.board.has(key, i):
+                                target = (key, i)
+                                break
+                        if target:
+                            break
+                    if target:
+                        break
+            if target is None:
+                with self._cv:
+                    self._cv.wait(timeout=self._gossip_s)
+                continue
+            adv = self._advertisers(*target)
+            if adv:
+                self._fetch_peer(*target, adv)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "pull": self.pull_id, "host": self.self_id,
+                "hosts": len(self.participants),
+                "owned_chunks": len(self._owned),
+                "chunks_refetched": self.chunks_refetched,
+                "dead": sorted(self._dead),
+                "peer_fill_bytes": sum(self._peer_bytes.values()),
+            }
+        out.update(self.board.stats())
+        return out
+
+
+class SwarmBlobReader:
+    """Store-shaped reads served off a swarm scheduler's chunk board —
+    what the delivery pipeline sees instead of a raw origin reader when
+    a pull runs in swarm mode. ``bytes_fetched`` keeps the pod-delivery
+    accounting honest: origin bytes (via the wrapped reader, headers
+    included) + peer-fill bytes attributed to this file."""
+
+    def __init__(self, scheduler: SwarmScheduler, remote_key: str,
+                 size: int, origin_reader: PeerBlobReader):
+        self.scheduler = scheduler
+        self.remote_key = remote_key
+        self._size = int(size)
+        self._origin = origin_reader
+
+    @property
+    def bytes_fetched(self) -> int:
+        return self._origin.bytes_fetched \
+            + self.scheduler.peer_bytes_for(self.remote_key)
+
+    def size(self, key: str) -> int:  # noqa: ARG002 — single-object reader
+        return self._size
+
+    def pread(self, key: str, length: int, offset: int) -> bytes:
+        out = bytearray(length)
+        self.pread_into(key, out, offset)
+        return bytes(out)
+
+    def pread_into(self, key: str, out, offset: int = 0) -> int:  # noqa: ARG002
+        view = memoryview(out).cast("B")
+        if view.nbytes == 0:
+            return 0
+        return self.scheduler.read_into(self.remote_key, view, offset)
 
 
 class PipelineFailure(OSError):
@@ -716,8 +1343,16 @@ def pull_manifest_to_hbm(
     cast_to=None,
     ici_complete: bool | None = None,
     streams: int | None = None,
+    swarm: "SwarmScheduler | None" = None,
 ):
     """Place ``model`` into HBM straight off a warm peer, shard-reads only.
+
+    ``swarm``: a started-or-startable :class:`SwarmScheduler` makes this
+    a swarm-mode cold pull — this host fetches only its ring-owned chunk
+    set from the warm-peer rotation and cross-fills the rest from its
+    swarm siblings (aggregate origin bytes ≈ 1× the manifest across the
+    pod, not N×). The caller owns the scheduler lifecycle: keep it open
+    until the whole pod is done, then ``close()`` it.
 
     Every host of a ``jax.distributed`` pod calls this with the same
     arguments; each fetches only its devices' byte windows over DCN and
@@ -753,9 +1388,11 @@ def pull_manifest_to_hbm(
         # the ROOT span of a sharded pull: every window read, budget
         # wait, retry and failover below stitches under this trace id —
         # and across hosts via the traceparent the wire calls carry
-        with trace.span("pull", model=model, source=source):
+        with trace.span("pull", model=model, source=source,
+                        swarm=(swarm.self_id if swarm else None)):
             return _pull_manifest_to_hbm(model, peers, mesh, plan, source,
-                                         cast_to, ici_complete, streams)
+                                         cast_to, ici_complete, streams,
+                                         swarm)
     finally:
         if profiling:
             try:
@@ -768,7 +1405,7 @@ def pull_manifest_to_hbm(
 
 
 def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
-                          ici_complete, streams):
+                          ici_complete, streams, swarm=None):
     import jax
 
     from demodel_tpu.sink.hbm import deliver_safetensors
@@ -797,7 +1434,7 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
     # restarts the pull pod-wide instead.
     if jax.process_count() == 1:
         others = [p.rstrip("/") for p in peers if p.rstrip("/") != peer]
-        peer_order = [peer] + _alive_peers(others)
+        peer_order = [peer] + _responsive_peers(others)
     else:
         peer_order = [peer]
     weight_files = []
@@ -821,22 +1458,38 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
         try:
             jobs = []
             health = PeerHealth.shared()
-            for i, f in enumerate(weight_files):
-                # stripe files round-robin across peers so a multi-peer
-                # pod spreads the DCN load; a peer missing the blob just
-                # falls over to the next in the rotated order. Peers whose
-                # breaker opened mid-pull drop out of the rotation HERE —
-                # a peer that died at file 3 must not greet files 4..N
-                # with a full read-timeout each (it re-enters via its
-                # half-open probe once the cooldown elapses)
-                rotated = peer_order[i % len(peer_order):] + \
-                    peer_order[:i % len(peer_order)]
+            # files stripe over the RESPONSIVE peers by consistent hash
+            # with BOUNDED LOADS: every host computes the same file→peer
+            # primary from the same ring+capacity walk, so the striping
+            # needs no rotation counter — and no peer's primary share
+            # exceeds ceil(files/N) (pure ring ownership is lumpy on a
+            # small file set; a capacity-spilled file's primary is still
+            # on its ring succession, so PeerSet.locate's ring-first
+            # guess misses at most into its probe fallback). The rest of
+            # the ring order is the failover rotation; peers whose
+            # breaker opened mid-pull drop out HERE — a peer that died
+            # at file 3 must not greet files 4..N with a full
+            # read-timeout each (it re-enters via its half-open probe
+            # once the cooldown elapses)
+            stripe_ring = HashRing(peer_order)
+            stripe = bounded_assign(
+                stripe_ring, [f["key"] for f in weight_files])
+            for f in weight_files:
+                primary = stripe.get(f["key"]) or peer_order[0]
+                rotated = [primary] + [p for p in peer_order
+                                       if p != primary]
                 reader, index = _reader_and_index(
                     f, health.healthy(rotated), streams)
+                fkey, fsize = f["key"], int(f["size"])
+                file_tensors[fkey] = set(index.tensors)
+                if swarm is not None:
+                    swarm.add_file(fkey, fsize, reader)
+                    reader = SwarmBlobReader(swarm, fkey, fsize, reader)
                 readers.append(reader)
-                file_tensors[f["key"]] = set(index.tensors)
                 for tname, spec in index.tensors.items():
-                    jobs.append((reader, f["key"], tname, spec))
+                    jobs.append((reader, fkey, tname, spec))
+            if swarm is not None:
+                swarm.start()
             delivered = _deliver_jobs_pipelined(
                 jobs, mesh, plan, cast_to=cast_to)
             merge_placement(placement, delivered)

@@ -53,6 +53,29 @@ def _budgets() -> list[dict[str, Any]]:
     return out
 
 
+def _swarm() -> list[dict[str, Any]]:
+    """Live swarm chunk progress (boards registered by any in-process
+    SwarmScheduler) — the per-host half of the pod-scale swarm debugging
+    story; ``tools/statusz.py --fleet`` joins these across hosts."""
+    placement = sys.modules.get("demodel_tpu.parallel.placement")
+    if placement is None:
+        return []
+    out: list[dict[str, Any]] = placement.boards_snapshot()
+    return out
+
+
+def _gossip() -> dict[str, Any]:
+    peer = sys.modules.get("demodel_tpu.parallel.peer")
+    if peer is None:
+        return {}
+    gossip = peer.PeerGossip._shared  # noqa: SLF001 — read-only peek:
+    # shared() would CREATE the registry; statusz must observe, not allocate
+    if gossip is None:
+        return {}
+    out: dict[str, Any] = gossip.describe()
+    return out
+
+
 def snapshot(extra: dict[str, Any] | None = None) -> dict[str, Any]:
     """The statusz document. ``extra`` lets a server add its own section
     (registered models, bind address) without forking the schema."""
@@ -75,6 +98,8 @@ def snapshot(extra: dict[str, Any] | None = None) -> dict[str, Any]:
         "inflight_spans": trace.inflight_tree(),
         "breakers": _breakers(),
         "budgets": _budgets(),
+        "swarm": _swarm(),
+        "gossip": _gossip(),
         "counters": metrics.HUB.snapshot(),
         "gauges": metrics.HUB.gauges(),
     }

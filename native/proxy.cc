@@ -405,6 +405,8 @@ std::string Metrics::hist_json() const {
   append_hist_family(&out, "serve_request_seconds", route_latency);
   out.append(",");
   append_hist_family(&out, "serve_ttfb_seconds", route_ttfb);
+  out.append(",");
+  append_hist_family(&out, "upstream_ttfb_seconds", route_upstream_ttfb);
   out.append("}");
   return out;
 }
@@ -592,15 +594,28 @@ class Session {
   // do we serve", not "how long do clients idle".
   std::chrono::steady_clock::time_point req_t0_, req_ttfb_;
   int req_route_ = kRouteOther;
-  bool req_timing_ = false, req_ttfb_set_ = false;
+  bool req_timing_ = false, req_ttfb_set_ = false, req_upstream_set_ = false;
 
   void route_begin() {
     req_t0_ = std::chrono::steady_clock::now();
     req_route_ = kRouteOther;
     req_timing_ = true;
     req_ttfb_set_ = false;
+    req_upstream_set_ = false;
   }
   void route_set(Route r) { req_route_ = r; }
+  // first upstream response byte of THIS request (forwards and fills
+  // only — cache hits never sample): the upstream-leg half of the
+  // blended proxy-route latency, observed immediately so the sample
+  // survives even when the serve leg later fails mid-stream
+  void upstream_first_byte() {
+    if (!req_timing_ || req_upstream_set_) return;
+    req_upstream_set_ = true;
+    double up = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - req_t0_)
+                    .count();
+    p_->metrics_.route_upstream_ttfb[req_route_].observe(up);
+  }
   void route_ttfb() {
     if (req_timing_ && !req_ttfb_set_) {
       req_ttfb_ = std::chrono::steady_clock::now();
@@ -1067,6 +1082,7 @@ class Session {
       send_simple(&client_, 502, "Bad Gateway", "upstream read failed");
       return false;
     }
+    upstream_first_byte();
     return stream_response(req, resp, uri, key, cacheable, auth_scope);
   }
 
@@ -1220,6 +1236,7 @@ class Session {
       send_simple(&client_, 502, "Bad Gateway", "upstream read failed");
       return 0;
     }
+    upstream_first_byte();
     std::string cl = resp.headers.get("content-length");
     int64_t size = cl.empty() ? -1 : ::atoll(cl.c_str());
     if (resp.status != 200 || size < 0 ||
@@ -1273,6 +1290,7 @@ class Session {
         send_simple(&client_, 502, "Bad Gateway", "upstream read failed");
         return 0;
       }
+      upstream_first_byte();
       bool keep = stream_response(req, ranged_resp, uri, key,
                                   /*cacheable=*/false, auth_scope);
       return keep ? 1 : 0;

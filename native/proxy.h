@@ -142,6 +142,13 @@ struct Metrics {
   // "hist" in the metrics JSON, typed histogram in the Python exposition
   Hist route_latency[kRouteCount];
   Hist route_ttfb[kRouteCount];
+  // upstream-leg TTFB (request head parsed → upstream response head
+  // received), observed ONLY on requests that actually went upstream —
+  // the proxy route's serve-leg histograms blend cache hits and
+  // forwards, so "is the origin slow or are we slow" needs this split:
+  // serve_ttfb ≈ upstream_ttfb on a forward (origin-bound), while a hit
+  // never samples here at all
+  Hist route_upstream_ttfb[kRouteCount];
   std::string hist_json() const;
   // serve-plane executor: *_active/*_queue_depth are gauges (refreshed by
   // Proxy::metrics_json from the live pool state), the rest are counters.

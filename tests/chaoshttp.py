@@ -14,6 +14,13 @@ included — while injecting faults per a seeded :class:`FaultPlan`:
   cleanly (FIN) — a short body the client must detect and resume;
 - ``corrupt``: flip a byte and serve the full (wrong) body — digests must
   catch it downstream; the wire itself looks healthy.
+- ``die``: the whole peer goes dark — the matching request gets an RST
+  and EVERY later request does too (the mid-pull host-death shape the
+  swarm's ownership-succession recovery is built for).
+
+``ChaosPeer(throttle_bps=...)`` rate-limits body writes — the
+constrained-origin-link shape the swarm bench uses to make "aggregate
+origin bytes" the measurable bottleneck on localhost.
 
 Faults are consumed deterministically (first matching spec, declared
 order, ``times`` each); ``plan.injected`` records what actually fired so
@@ -35,11 +42,12 @@ import requests
 
 from demodel_tpu.utils import trace
 
-KINDS = ("reset-at-byte", "stall", "503-burst", "truncate", "corrupt")
+KINDS = ("reset-at-byte", "stall", "503-burst", "truncate", "corrupt",
+         "die")
 
 
 #: faults applied before any upstream forwarding (no body involved)
-PRE_KINDS = ("503-burst", "stall")
+PRE_KINDS = ("503-burst", "stall", "die")
 
 
 @dataclass
@@ -134,10 +142,15 @@ class ChaosPeer:
     can cross-check window-resume accounting from the wire side."""
 
     def __init__(self, upstream: str, plan: FaultPlan,
-                 forward_timeout: float = 30.0):
+                 forward_timeout: float = 30.0,
+                 throttle_bps: int | None = None):
         self.upstream = upstream.rstrip("/")
         self.plan = plan
         self.forward_timeout = forward_timeout
+        #: body bytes/sec cap per connection (None = line rate): the
+        #: constrained-origin-link simulation for the swarm bench
+        self.throttle_bps = throttle_bps
+        self.dead = False  # a fired "die" fault (or kill()) sticks
         self.bytes_served = 0
         #: every request seen: (path, Range header or "") — lets tests
         #: prove a recovery resumed at the received offset instead of
@@ -174,6 +187,11 @@ class ChaosPeer:
         self._srv.shutdown()
         self._srv.server_close()
 
+    def kill(self) -> None:
+        """Deterministic mid-test host death: every request from now on
+        is RST — the direct-control twin of the ``die`` fault kind."""
+        self.dead = True
+
     def __enter__(self) -> "ChaosPeer":
         return self
 
@@ -184,6 +202,23 @@ class ChaosPeer:
     def _count(self, n: int) -> None:
         with self._count_lock:
             self.bytes_served += n
+
+    def _write_body(self, h: BaseHTTPRequestHandler, body: bytes) -> None:
+        """Body write, rate-limited to ``throttle_bps`` when set (64 KB
+        slices + sleeps — coarse, but the aggregate rate is what the
+        bench's origin-link simulation needs)."""
+        if not self.throttle_bps:
+            h.wfile.write(body)
+            return
+        slice_bytes = 64 << 10
+        t0 = time.monotonic()
+        sent = 0
+        while sent < len(body) and not self._stop.is_set():
+            h.wfile.write(body[sent:sent + slice_bytes])
+            sent += slice_bytes
+            ahead = sent / self.throttle_bps - (time.monotonic() - t0)
+            if ahead > 0:
+                time.sleep(ahead)
 
     def _rst(self, h: BaseHTTPRequestHandler) -> None:
         """Kill the client socket with an RST, not a FIN.
@@ -223,7 +258,18 @@ class ChaosPeer:
     def _serve_traced(self, h: BaseHTTPRequestHandler, sp) -> None:
         with self._count_lock:
             self.requests_log.append((h.path, h.headers.get("Range", "")))
+        if self.dead:
+            sp.event("fault", kind="dead-host")
+            self._rst(h)
+            return
         fault = self.plan.take(h.path)
+
+        if fault is not None and fault.kind == "die":
+            self.plan.record("die", h.path)
+            sp.event("fault", kind="die")
+            self.dead = True
+            self._rst(h)
+            return
 
         if fault is not None and fault.kind == "503-burst":
             self.plan.record("503-burst", h.path)
@@ -279,7 +325,7 @@ class ChaosPeer:
             fault = None
         if fault is None:
             h.end_headers()
-            h.wfile.write(body)
+            self._write_body(h, body)
             self._count(len(body))
             return
 

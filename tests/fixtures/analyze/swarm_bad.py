@@ -1,0 +1,32 @@
+# demodel: swarm-plane
+"""Golden fixture for swarm-owner-only-origin: origin chunk fetches that
+bypass the SwarmScheduler ownership decision."""
+
+from demodel_tpu.sink.remote import _swarm_origin_read
+from demodel_tpu.sink.remote import _swarm_origin_read as sneaky_read
+
+
+def warm_locally(reader, key):
+    # direct module-level call: an un-owned origin fetch
+    return _swarm_origin_read(reader, key, 0, 1 << 20)
+
+
+class EagerPrefetcher:
+    """Not the scheduler: class scope does not legitimize the call."""
+
+    def prefetch(self, reader, key):
+        return _swarm_origin_read(reader, key, 0, 1 << 20)
+
+    def prefetch_aliased(self, reader, key):
+        return sneaky_read(reader, key, 1 << 20, 1 << 20)
+
+
+def via_module(remote, reader, key):
+    # attribute form through the module object
+    return remote._swarm_origin_read(reader, key, 0, 4096)
+
+
+class SwarmScheduler:
+    def _fetch_origin(self, reader, key):
+        # inside the scheduler: the legitimate ownership-decided path
+        return _swarm_origin_read(reader, key, 0, 1 << 20)

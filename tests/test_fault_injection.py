@@ -50,6 +50,8 @@ SHAPE = (896, 896)
 @pytest.fixture(autouse=True)
 def _fast_wire(monkeypatch):
     """Fast, deterministic wire knobs + fresh process-wide state."""
+    from demodel_tpu.parallel.peer import PeerGossip
+
     monkeypatch.setenv("DEMODEL_RETRY_BASE_MS", "20")
     monkeypatch.setenv("DEMODEL_RETRY_DEADLINE", "60")
     monkeypatch.setenv("DEMODEL_BREAKER_COOLDOWN", "1")
@@ -62,9 +64,11 @@ def _fast_wire(monkeypatch):
     # 1-CPU CI box
     monkeypatch.setenv("DEMODEL_PROXY_IDLE_TIMEOUT", "1")
     PeerHealth.reset_shared()
+    PeerGossip.reset_shared()
     m.HUB.reset()
     yield
     PeerHealth.reset_shared()
+    PeerGossip.reset_shared()
 
 
 def _key(tag: str, i) -> str:
@@ -234,14 +238,19 @@ def test_stall_past_deadline_fails_over(tmp_path, mesh8, monkeypatch):
             _seed_store(store_b, "stall-a", len(files), 0)  # same content
         finally:
             store_b.close()
+        # one shared plan on BOTH rotation members (the consistent-hash
+        # striping decides which peer is file 0's primary): the stall
+        # fires on whichever shim serves it, and the failover target —
+        # the other shim — serves clean (times=1 exhausted)
         plan = FaultPlan(
             FaultSpec("stall", path=files[0]["key"], stall_secs=6.0),
             seed=1)
         with ProxyServer(cfg_b, verbose=False) as node_b, \
-                ChaosPeer(node_a.url, plan) as chaos:
+                ChaosPeer(node_a.url, plan) as chaos_a, \
+                ChaosPeer(node_b.url, plan) as chaos_b:
             t0 = time.monotonic()
             report, placed = pull_manifest_to_hbm(
-                MODEL, [chaos.url, node_b.url], mesh=mesh8)
+                MODEL, [chaos_a.url, chaos_b.url], mesh=mesh8)
             elapsed = time.monotonic() - t0
     assert plan.fired("stall") == 1
     _assert_exact(placed, tensors)
@@ -275,11 +284,16 @@ def test_corrupt_header_fails_over_to_clean_peer(tmp_path, mesh8):
     from demodel_tpu.sink.remote import pull_manifest_to_hbm
 
     with _warm_node(tmp_path, "ch") as (node, (tensors, files, weight)):
+        # one shared plan on BOTH rotation members: the consistent-hash
+        # striping decides which peer serves file 0's header first, so
+        # the corruption rides whichever shim that is, and the failover
+        # target (the other shim) serves clean — ring-order-agnostic
         plan = FaultPlan(
             FaultSpec("corrupt", path=files[0]["key"], at_byte=0), seed=4)
-        with ChaosPeer(node.url, plan) as chaos:
+        with ChaosPeer(node.url, plan) as chaos_a, \
+                ChaosPeer(node.url, plan) as chaos_b:
             report, placed = pull_manifest_to_hbm(
-                MODEL, [chaos.url, node.url], mesh=mesh8)
+                MODEL, [chaos_a.url, chaos_b.url], mesh=mesh8)
     assert plan.fired("corrupt") == 1
     _assert_exact(placed, tensors)
 
@@ -412,6 +426,124 @@ def test_restore_survives_mid_tensor_reset(tmp_path, mesh8):
     _assert_exact(result, tensors)
     assert elapsed < 60
     assert _retries_total() >= 1
+
+
+# -------------------------------------------------------- swarm chaos
+
+
+def test_swarm_pull_survives_peer_death_and_reset(tmp_path, mesh8,
+                                                  monkeypatch):
+    """The pod-scale swarm contract under chaos: a 3-host swarm pull with
+    (a) an RST mid-chunk on the origin link (window recovery inside the
+    chunk fetch) and (b) one swarm host dying the moment a sibling first
+    fetches a chunk from it (the ``die`` fault). Must hold: bytes-exact
+    delivery on the pulling host, aggregate origin traffic ≈ manifest
+    size + only the dead host's re-owned chunks (never a wholesale
+    re-pull), and the re-own count visible on the metrics scrape."""
+    import threading
+
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+    from demodel_tpu.sink.remote import (
+        PeerBlobReader,
+        SwarmScheduler,
+        pull_manifest_to_hbm,
+    )
+
+    monkeypatch.setenv("DEMODEL_SWARM_CHUNK_MB", "1")
+    monkeypatch.setenv("DEMODEL_SWARM_GOSSIP_MS", "150")
+    monkeypatch.setenv("DEMODEL_SWARM_FILL_TIMEOUT", "4")
+    chunk = 1 << 20
+    with _warm_node(tmp_path, "swarm") as (node, (tensors, files, weight)):
+        plan = FaultPlan(
+            FaultSpec("reset-at-byte", path=files[1]["key"],
+                      at_byte=600_000, min_body=1 << 20),
+            seed=17)
+        die_plan = FaultPlan(FaultSpec("die", path="/chunk/"), seed=18)
+        servers, stores, scheds = [], [], {}
+        chaos_c = None
+        with ChaosPeer(node.url, plan) as origin:
+            try:
+                urls = {}
+                for hid in ("hA", "hB", "hC"):
+                    st = Store(tmp_path / f"swarm-{hid}")
+                    srv = RestoreServer(RestoreRegistry(st),
+                                        host="127.0.0.1").start()
+                    stores.append(st)
+                    servers.append(srv)
+                    urls[hid] = f"http://127.0.0.1:{srv.port}"
+                # hC's serve surface dies (RST + permanently dark) the
+                # first time a sibling pulls a chunk off it — i.e. right
+                # AFTER it advertised possession: the sharpest mid-pull
+                # death shape for the succession logic
+                chaos_c = ChaosPeer(urls["hC"], die_plan)
+                participants = {"hA": urls["hA"], "hB": urls["hB"],
+                                "hC": chaos_c.url}
+                for hid in participants:
+                    scheds[hid] = SwarmScheduler("chaos-swarm", hid,
+                                                 participants)
+                for hid in ("hB", "hC"):
+                    s = scheds[hid]
+                    for f in files:
+                        s.add_file(f["key"], int(f["size"]),
+                                   PeerBlobReader(origin.url, f["key"],
+                                                  int(f["size"])))
+                    s.start()
+                errors: list = []
+
+                def participate(s):
+                    try:
+                        s.fetch_all()
+                    except Exception as e:  # noqa: BLE001 — surfaced below
+                        errors.append(e)
+
+                ths = [threading.Thread(target=participate,
+                                        args=(scheds[h],), daemon=True)
+                       for h in ("hB", "hC")]
+                for t in ths:
+                    t.start()
+                t0 = time.monotonic()
+                report, placed = pull_manifest_to_hbm(
+                    MODEL, [origin.url], mesh=mesh8, swarm=scheds["hA"])
+                elapsed = time.monotonic() - t0
+                for t in ths:
+                    t.join(timeout=90)
+                assert not any(t.is_alive() for t in ths), \
+                    "a swarm participant wedged"
+                assert errors == []
+                owned_c = scheds["hC"].stats()["owned_chunks"]
+            finally:
+                for s in scheds.values():
+                    s.close()
+                if chaos_c is not None:
+                    chaos_c.close()
+                for srv in servers:
+                    srv.stop()
+                for st in stores:
+                    st.close()
+    # bytes-exact despite the origin RST and the dead sibling
+    _assert_exact(placed, tensors)
+    assert plan.fired("reset-at-byte") == 1, "the origin RST never fired"
+    assert die_plan.fired("die") == 1, "hC never died"
+    assert elapsed < 120, f"unbounded swarm recovery: {elapsed:.1f}s"
+    # succession, not wholesale: only hC's unserved chunks re-sourced,
+    # each exactly once (the ring successor), proven from the scrape
+    refetched = m.HUB.get("swarm_chunks_refetched_total")
+    assert 1 <= refetched <= owned_c, \
+        f"re-own miscounted: {refetched} of {owned_c} hC-owned chunks"
+    origin_chunk_bytes = m.HUB.get("swarm_origin_bytes_total")
+    assert weight <= origin_chunk_bytes <= weight + refetched * chunk, \
+        f"aggregate origin chunk bytes {origin_chunk_bytes} vs manifest " \
+        f"{weight} (+{refetched} re-owned chunks): swarm degenerated " \
+        "into per-host origin pulls"
+    # wire truth from the shim side: total origin body bytes (chunks +
+    # manifest/header reads per host) stay far under the 3× a
+    # non-swarm 3-host pull would move
+    assert origin.bytes_served <= weight + refetched * chunk + (2 << 20), \
+        f"origin served {origin.bytes_served} for a {weight}-byte manifest"
+    scrape = m.render()
+    assert "# TYPE demodel_swarm_chunks_refetched_total counter" in scrape
+    assert "# TYPE demodel_swarm_origin_bytes_total counter" in scrape
+    assert "# TYPE demodel_swarm_peer_bytes_total counter" in scrape
 
 
 # ------------------------------------------------------ the full matrix

@@ -417,12 +417,19 @@ def test_fetch_manifest_skips_open_breaker_peer():
 
 def test_peerset_locate_skips_open_breaker_peer():
     """The striping/locate side of the same contract: an open peer's
-    index is never even requested."""
-    from demodel_tpu.parallel.peer import PeerSet
+    index is never even requested — in the ring-first phase OR the probe
+    fallback — and a key only the cooled-down peer holds forces the
+    re-dial once the cooldown elapses (ring order can't satisfy it from
+    the healthy peer's gossip)."""
+    from demodel_tpu.parallel.peer import PeerGossip, PeerSet
 
-    idx = json.dumps({"keys": [{"key": "aaaabbbbccccdddd"}]}).encode()
-    srv_a, url_a, handler_a = _counting_server(idx)
-    srv_b, url_b, handler_b = _counting_server(idx)
+    PeerGossip.reset_shared()
+    shared, only_a = "aaaabbbbccccdddd", "eeeeffff00001111"
+    idx_a = json.dumps({"keys": [{"key": shared},
+                                 {"key": only_a}]}).encode()
+    idx_b = json.dumps({"keys": [{"key": shared}]}).encode()
+    srv_a, url_a, handler_a = _counting_server(idx_a)
+    srv_b, url_b, handler_b = _counting_server(idx_b)
     try:
         now = [0.0]
         health = f.PeerHealth(threshold=1, cooldown=60.0,
@@ -430,15 +437,15 @@ def test_peerset_locate_skips_open_breaker_peer():
         health.record_failure(url_a)
         ps = PeerSet([url_a, url_b], timeout=5, health=health,
                      policy=f.RetryPolicy(max_attempts=1, deadline=5))
-        assert ps.locate("aaaabbbbccccdddd") == url_b
+        assert ps.locate(shared) == url_b
         assert handler_a.hits == []
-        # cooldown over → A is probed again and wins (listed first)
+        # cooldown over → only A can answer for its exclusive key, so
+        # locate MUST probe it again (B's fresh gossip says no)
         now[0] = 61.0
-        ps2 = PeerSet([url_a, url_b], timeout=5, health=health,
-                      policy=f.RetryPolicy(max_attempts=1, deadline=5))
-        assert ps2.locate("aaaabbbbccccdddd") == url_a
+        assert ps.locate(only_a) == url_a
         assert len(handler_a.hits) == 1
     finally:
+        PeerGossip.reset_shared()
         for s in (srv_a, srv_b):
             s.shutdown()
             s.server_close()

@@ -149,6 +149,53 @@ def test_render_survives_broken_proxy():
     assert "demodel_pulls_total 1" in body  # hub still renders
 
 
+def test_upstream_ttfb_split_from_serve_leg(tmp_path):
+    """The proxy route's blended latency is split: a FORWARD samples the
+    new upstream-leg TTFB family (request head → upstream response
+    head), a local hit never does — so "is the origin slow or are we
+    slow" is answerable from the scrape."""
+    import requests
+
+    upstream = _node(tmp_path, "up")
+    _warm(upstream, "upstreamobj00001", b"u" * (64 << 10))
+    upstream.start()
+    proxy = _node(tmp_path, "fwd")
+    proxy.start()
+    try:
+        # hot hits on the proxy itself: serve-leg samples only
+        _warm(proxy, "hitobj0000000001", b"h" * (64 << 10))
+        status, _h, body = _get(proxy.port, "/peer/object/hitobj0000000001")
+        assert status == 200 and len(body) == 64 << 10
+        hist = proxy.metrics()["hist"]
+        assert "proxy" not in hist["upstream_ttfb_seconds"]["routes"], \
+            "a local hit must not sample the upstream leg"
+        # an absolute-form plain-HTTP forward through the proxy
+        r = requests.get(
+            f"http://127.0.0.1:{upstream.port}/peer/object/upstreamobj00001",
+            proxies={"http": f"http://127.0.0.1:{proxy.port}"}, timeout=15)
+        assert r.status_code == 200 and len(r.content) == 64 << 10
+        # the client can finish reading before the server-side bracket
+        # closes (route_end runs after the last write) — poll briefly
+        deadline = time.monotonic() + 5.0
+        while True:
+            hist = proxy.metrics()["hist"]
+            if "proxy" in hist["serve_ttfb_seconds"]["routes"] \
+                    or time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+        up = hist["upstream_ttfb_seconds"]["routes"]["proxy"]
+        assert up["count"] >= 1
+        assert hist["serve_ttfb_seconds"]["routes"]["proxy"]["count"] >= 1
+        scrape = m.render(proxy=proxy)
+        assert "# TYPE demodel_proxy_upstream_ttfb_seconds histogram" \
+            in scrape
+        assert 'demodel_proxy_upstream_ttfb_seconds_bucket{route="proxy"' \
+            in scrape
+    finally:
+        proxy.stop()
+        upstream.stop()
+
+
 # ------------------------------------------------- serve counters under load
 
 

@@ -40,6 +40,7 @@ ALL_RULES = {
     "orphaned-async-task",
     "wire-call-policy",
     "metric-hygiene",
+    "swarm-owner-only-origin",
 }
 
 #: fixture file → exact expected (rule, line) findings
@@ -100,6 +101,12 @@ GOLDEN = {
         ("wire-call-policy", 19),
         ("wire-call-policy", 23),
         ("wire-call-policy", 27),
+    },
+    "swarm_bad.py": {
+        ("swarm-owner-only-origin", 11),
+        ("swarm-owner-only-origin", 18),
+        ("swarm-owner-only-origin", 21),
+        ("swarm-owner-only-origin", 26),
     },
     "metric_bad.py": {
         ("metric-hygiene", 15),

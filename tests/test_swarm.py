@@ -1,0 +1,463 @@
+"""Pod-scale swarm pull: ring placement, chunk boards, the scheduler's
+disjoint-origin/cross-fill/succession contracts, gossiped peer index,
+and the fleet statusz view.
+
+The integration tests run a REAL multi-host swarm in one process: N
+SwarmSchedulers, each advertising its chunk board over an actual
+RestoreServer, pulling one manifest off a live warm ProxyServer — the
+same wiring a pod uses, ports and all, just sharing a process. Dep-light
+(no cryptography, no mesh placement), so the whole file rides tier-1.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from demodel_tpu.config import ProxyConfig
+from demodel_tpu.parallel.placement import (
+    ChunkBoard,
+    HashRing,
+    bitmap_indices,
+    bounded_assign,
+    chunk_count,
+    chunk_span,
+)
+from demodel_tpu.proxy import ProxyServer
+from demodel_tpu.store import Store
+from demodel_tpu.utils import metrics as m
+from demodel_tpu.utils.faults import PeerHealth
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    from demodel_tpu.parallel.peer import PeerGossip
+
+    monkeypatch.setenv("DEMODEL_SWARM_CHUNK_MB", "1")
+    monkeypatch.setenv("DEMODEL_SWARM_GOSSIP_MS", "150")
+    monkeypatch.setenv("DEMODEL_SWARM_FILL_TIMEOUT", "4")
+    monkeypatch.setenv("DEMODEL_PROXY_IDLE_TIMEOUT", "1")
+    PeerHealth.reset_shared()
+    PeerGossip.reset_shared()
+    m.HUB.reset()
+    yield
+    PeerHealth.reset_shared()
+    PeerGossip.reset_shared()
+
+
+# ------------------------------------------------------------ placement unit
+
+
+def test_ring_is_deterministic_and_stable():
+    nodes = ["host-a", "host-b", "host-c"]
+    r1, r2 = HashRing(nodes), HashRing(list(reversed(nodes)))
+    keys = [f"k{i}" for i in range(500)]
+    assert [r1.owner(k) for k in keys] == [r2.owner(k) for k in keys], \
+        "every host must compute the identical key→node map"
+    succ = r1.owners("k1", 3)
+    assert len(succ) == 3 and len(set(succ)) == 3
+    # consistency: dropping one node moves ONLY its keys
+    shrunk = HashRing(["host-a", "host-b"])
+    for k in keys:
+        if r1.owner(k) != "host-c":
+            assert shrunk.owner(k) == r1.owner(k)
+
+
+def test_bounded_assign_caps_every_node():
+    ring = HashRing([f"h{i}" for i in range(4)])
+    items = [f"file0:{i}" for i in range(24)]
+    got = bounded_assign(ring, items)
+    assert set(got) == set(items)
+    loads: dict = {}
+    for node in got.values():
+        loads[node] = loads.get(node, 0) + 1
+    assert max(loads.values()) <= 6, (
+        f"capacity bound violated: {loads} — the swarm wall-clock is the "
+        "largest owned share's origin time")
+    # deterministic across independent computations (what lets N hosts
+    # agree with zero coordination)
+    assert got == bounded_assign(HashRing([f"h{i}" for i in range(4)]),
+                                 list(items))
+
+
+def test_chunk_grid_and_board_summary():
+    size = (5 << 20) + 123
+    n = chunk_count(size, 1 << 20)
+    assert n == 6
+    off, ln = chunk_span(size, 1 << 20, 5)
+    assert off == 5 << 20 and ln == 123
+    board = ChunkBoard("pull-x", "host-a")
+    board.add_file("fk", n)
+    board.put("fk", 0, b"a" * 10)
+    board.put("fk", 5, b"b" * 10)
+    s = board.summary()
+    assert s["pull"] == "pull-x" and s["host"] == "host-a"
+    assert bitmap_indices(s["files"]["fk"]["have"], n) == {0, 5}
+    assert board.have("fk") == {0, 5}
+    v = s["v"]
+    board.put("fk", 1, b"c")
+    assert board.summary()["v"] > v, "possession changes must version"
+
+
+def test_scheduler_merge_rejects_stale_and_junk():
+    from demodel_tpu.sink.remote import SwarmScheduler
+
+    s = SwarmScheduler("p", "a", {"a": "http://x", "b": "http://y"})
+    try:
+        s.add_file("fk", 3 << 20, object())
+        fresh = {"pull": "p", "host": "b", "v": 5,
+                 "files": {"fk": {"n": 3, "have": "03"}}}
+        s.merge_summary("b", fresh)
+        assert s._advertisers("fk", 0) == ["b"]  # noqa: SLF001
+        stale = {"v": 2, "files": {"fk": {"n": 3, "have": "04"}}}
+        s.merge_summary("b", stale)
+        assert s._advertisers("fk", 1) == ["b"], \
+            "a stale (lower-version) summary must not replace a newer one"
+        # junk shapes degrade silently (the gossip analogue of
+        # peer-json-shape)
+        s.merge_summary("b", "not a dict")
+        s.merge_summary("b", {"v": "NaN?", "files": 7})
+        assert s._advertisers("fk", 1) == ["b"]  # noqa: SLF001
+    finally:
+        s.close()
+
+
+def test_restarted_sibling_resurrects_despite_lower_version():
+    # a RESTARTED sibling's board restarts its version counter near
+    # zero: death must reset the staleness bar or the first successful
+    # poll after the restart is vetoed as "stale" and the host stays
+    # dead forever (the _pump_gossip resurrection contract)
+    from demodel_tpu.sink.remote import SwarmScheduler
+
+    s = SwarmScheduler("p", "a", {"a": "http://x", "b": "http://y"})
+    try:
+        s.add_file("fk", 3 << 20, object())
+        s.merge_summary("b", {"v": 50,
+                              "files": {"fk": {"n": 3, "have": "03"}}})
+        for _ in range(3):
+            s._poll_failed("b")  # noqa: SLF001
+        assert "b" in s._snapshot_dead()  # noqa: SLF001
+        # restarted board: fresh low version, different possession
+        s.merge_summary("b", {"v": 1,
+                              "files": {"fk": {"n": 3, "have": "04"}}})
+        assert "b" not in s._snapshot_dead(), \
+            "a successful poll must resurrect a dead sibling even when " \
+            "its restarted board's version restarted below the old one"
+        assert s._advertisers("fk", 2) == ["b"]  # noqa: SLF001
+    finally:
+        s.close()
+
+
+# ------------------------------------------------------- swarm integration
+
+
+def _seed_origin(tmp_path, n_files=2, mb=3, tag="sw"):
+    cfg = ProxyConfig(
+        host="127.0.0.1", port=0, mitm_hosts=[], no_mitm=True,
+        cache_dir=tmp_path / f"{tag}-origin-cache",
+        data_dir=tmp_path / f"{tag}-origin-data")
+    store = Store(cfg.cache_dir / "proxy")
+    rng = np.random.default_rng(7)
+    files = []
+    try:
+        for i in range(n_files):
+            body = rng.bytes(mb << 20)
+            key = f"{tag}key{i}"
+            store.put(key, body, {"content-type": "application/octet-stream"})
+            files.append({"key": key, "size": len(body),
+                          "sha256": hashlib.sha256(body).hexdigest()})
+    finally:
+        store.close()
+    node = ProxyServer(cfg, verbose=False)
+    node.start()
+    return node, files
+
+
+def _swarm_hosts(tmp_path, host_ids, tag="sw"):
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+
+    servers, stores, participants = [], [], {}
+    for hid in host_ids:
+        st = Store(tmp_path / f"{tag}-{hid}")
+        srv = RestoreServer(RestoreRegistry(st), host="127.0.0.1").start()
+        stores.append(st)
+        servers.append(srv)
+        participants[hid] = f"http://127.0.0.1:{srv.port}"
+    return servers, stores, participants
+
+
+def _teardown(scheds, servers, stores):
+    for s in scheds:
+        s.close()
+    for srv in servers:
+        srv.stop()
+    for st in stores:
+        st.close()
+
+
+def test_three_host_swarm_disjoint_origin_and_exact_bytes(tmp_path):
+    """The core contract on real wire: 3 hosts, every chunk crosses
+    origin exactly once (aggregate origin chunk bytes == manifest size),
+    cross-fills cover the rest, every host ends bytes-exact — and the
+    live surfaces (statusz swarm section, --fleet) see the progress."""
+    from demodel_tpu.sink.remote import PeerBlobReader, SwarmScheduler
+    from demodel_tpu.utils import statusz
+
+    origin, files = _seed_origin(tmp_path, n_files=2, mb=3)
+    servers, stores, participants = _swarm_hosts(
+        tmp_path, ("hA", "hB", "hC"))
+    scheds = []
+    try:
+        for hid in participants:
+            s = SwarmScheduler("t3", hid, participants)
+            for f in files:
+                s.add_file(f["key"], f["size"],
+                           PeerBlobReader(origin.url, f["key"], f["size"]))
+            scheds.append(s)
+        for s in scheds:
+            s.start()
+        # disjoint partition: the three owned sets tile the grid
+        owned = [set(s._owned) for s in scheds]  # noqa: SLF001
+        total_chunks = sum(chunk_count(f["size"], 1 << 20) for f in files)
+        assert sum(len(o) for o in owned) == total_chunks
+        assert not (owned[0] & owned[1] or owned[0] & owned[2]
+                    or owned[1] & owned[2])
+
+        digests: dict = {}
+        errors: list = []
+
+        def run(s):
+            try:
+                s.fetch_all()
+                out = {}
+                for f in files:
+                    buf = bytearray(f["size"])
+                    s.read_into(f["key"], memoryview(buf), 0)
+                    out[f["key"]] = hashlib.sha256(buf).hexdigest()
+                digests[s.self_id] = out
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        ths = [threading.Thread(target=run, args=(s,)) for s in scheds]
+        t0 = time.monotonic()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=90)
+        assert errors == [] and len(digests) == 3
+        assert time.monotonic() - t0 < 90
+        for d in digests.values():
+            for f in files:
+                assert d[f["key"]] == f["sha256"]
+        size = sum(f["size"] for f in files)
+        assert m.HUB.get("swarm_origin_bytes_total") == size, \
+            "aggregate origin chunk traffic must be exactly 1x the manifest"
+        assert m.HUB.get("swarm_peer_bytes_total") == 2 * size, \
+            "the other N-1 copies must travel peer-to-peer"
+        assert m.HUB.get("swarm_chunks_refetched_total") == 0
+
+        # the live surfaces see it: statusz swarm section + fleet join
+        doc = statusz.snapshot()
+        assert any(b["pull"] == "t3" and b["chunks_have"] == total_chunks
+                   for b in doc["swarm"])
+        from tools.statusz import fleet_report
+
+        fleet = fleet_report(list(participants.values()))
+        assert fleet["hosts_up"] == 3 and fleet["hosts_down"] == 0
+        assert fleet["swarm_progress"]["pct"] == 100.0
+    finally:
+        _teardown(scheds, servers, stores)
+        origin.stop()
+
+
+def test_dead_host_chunks_reowned_not_repulled(tmp_path):
+    """A host that never comes up: its whole owned arc is re-sourced by
+    ring successors, once each — origin bytes stay exactly 1× the
+    manifest (the dead host's chunks cross origin once, via whoever
+    re-owned them, never wholesale per surviving host)."""
+    from demodel_tpu.sink.remote import PeerBlobReader, SwarmScheduler
+
+    # 6 chunks over 3 hosts: capacity ceil(6/3)=2, so every host —
+    # including the dead one — owns exactly 2 chunks by construction
+    origin, files = _seed_origin(tmp_path, n_files=1, mb=6, tag="dead")
+    servers, stores, participants = _swarm_hosts(
+        tmp_path, ("hA", "hB"), tag="dead")
+    # hC is in the ring but its endpoint never answers
+    participants = dict(participants)
+    participants["hC"] = "http://127.0.0.1:9"  # discard port: dead
+    scheds = []
+    try:
+        for hid in ("hA", "hB"):
+            s = SwarmScheduler("tdead", hid, participants)
+            for f in files:
+                s.add_file(f["key"], f["size"],
+                           PeerBlobReader(origin.url, f["key"], f["size"]))
+            scheds.append(s)
+        for s in scheds:
+            s.start()
+        ghost = SwarmScheduler("tdead-ghost", "hC", participants)
+        for f in files:
+            ghost.add_file(f["key"], f["size"], object())
+        ghost._plan()  # noqa: SLF001 — how many chunks the ghost owned
+        owned_c = len(ghost._owned)  # noqa: SLF001
+        ghost.close()
+        assert owned_c > 0, "hC must own part of the grid for the test"
+
+        for s in scheds:
+            s.fetch_all()
+        for s in scheds:
+            for f in files:
+                buf = bytearray(f["size"])
+                s.read_into(f["key"], memoryview(buf), 0)
+                assert hashlib.sha256(buf).hexdigest() == f["sha256"]
+        size = sum(f["size"] for f in files)
+        assert m.HUB.get("swarm_origin_bytes_total") == size
+        assert m.HUB.get("swarm_chunks_refetched_total") == owned_c, \
+            "each dead-owned chunk re-owns exactly once (the successor)"
+    finally:
+        _teardown(scheds, servers, stores)
+        origin.stop()
+
+
+def test_swarm_routes_404_without_scheduler(tmp_path):
+    """A restore node that never swarmed answers 404 on the swarm
+    surface (and stays dep-light: no placement import)."""
+    import urllib.error
+    import urllib.request
+
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+
+    st = Store(tmp_path / "plain")
+    try:
+        with RestoreServer(RestoreRegistry(st), host="127.0.0.1") as srv:
+            for path in ("/swarm/nope/h1/chunks", "/swarm/nope/h1/chunk/k/0"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}{path}", timeout=5)
+                assert ei.value.code == 404
+    finally:
+        st.close()
+
+
+# --------------------------------------------------------- gossip + locate
+
+
+def test_gossip_split_and_freshness():
+    from demodel_tpu.parallel.peer import PeerGossip
+
+    g = PeerGossip(refresh_s=60.0)  # refresher never ticks in-test
+    g.observe("http://a:1", {"k1", "k2"})
+    g.observe("http://b:1", None, ok=False)
+    alive, dead, unknown = g.split(
+        ["http://a:1", "http://b:1", "http://c:1"])
+    assert alive == ["http://a:1"]
+    assert dead == ["http://b:1"]
+    assert unknown == ["http://c:1"]
+    assert g.keys("http://a:1") == frozenset({"k1", "k2"})
+    assert g.keys("http://b:1") is None
+    # bounded: an oversized index keeps a deterministic subset
+    g2 = PeerGossip(refresh_s=60.0, max_keys=4)
+    g2.observe("http://a:1", {f"key{i}" for i in range(100)})
+    assert len(g2.keys("http://a:1")) == 4
+
+
+def test_locate_answers_from_ring_gossip_without_dialing():
+    """A key whose ring owner has fresh gossiped possession resolves
+    with ZERO wire traffic — the probe-broadcast replacement. The peers
+    here are unroutable on purpose: any dial would hang/fail."""
+    from demodel_tpu.parallel.peer import PeerGossip, PeerSet
+
+    peers = ["http://127.0.0.1:9", "http://127.0.0.2:9"]
+    key = "deadbeef00112233"
+    ps = PeerSet(peers, timeout=1)
+    owner = ps._ring().owner(key)  # noqa: SLF001 — the test needs the owner
+    PeerGossip.shared().observe(owner, {key})
+    t0 = time.monotonic()
+    assert ps.locate(key) == owner
+    assert time.monotonic() - t0 < 0.5, "gossip hit must not dial"
+
+
+def test_locate_falls_back_to_probe_on_ring_miss(tmp_path):
+    """Gossip silent → the existing index-probe scan still finds the
+    key (ring-first is an optimization, never a correctness change)."""
+    from demodel_tpu.parallel.peer import PeerSet
+
+    origin, files = _seed_origin(tmp_path, n_files=1, mb=1, tag="loc")
+    try:
+        ps = PeerSet([origin.url], timeout=5)
+        assert ps.locate(files[0]["key"]) == origin.url
+        assert ps.locate("absent-key-0000") is None
+    finally:
+        origin.stop()
+
+
+def test_responsive_peers_rides_gossip(tmp_path):
+    """The striping-rotation build: gossip-alive peers join with no
+    probe, gossip-dead peers drop, unknown peers still get the one-shot
+    concurrent probe (cold start)."""
+    from demodel_tpu.parallel.peer import PeerGossip
+    from demodel_tpu.sink.remote import _responsive_peers
+
+    origin, _files = _seed_origin(tmp_path, n_files=1, mb=1, tag="resp")
+    try:
+        g = PeerGossip.shared()
+        g.observe("http://127.0.0.1:9", None, ok=False)   # fresh-dead
+        g.observe("http://10.255.255.1:9", {"k"})         # fresh-alive,
+        # unroutable: proves membership needs no probe
+        got = _responsive_peers(
+            ["http://10.255.255.1:9", "http://127.0.0.1:9", origin.url],
+            timeout=2.0)
+        assert "http://10.255.255.1:9" in got, "gossip-alive skipped probe"
+        assert "http://127.0.0.1:9" not in got, "gossip-dead must drop"
+        assert origin.url in got, "unknown peer still probes (cold start)"
+    finally:
+        origin.stop()
+
+
+# ------------------------------------------------------------- fleet tool
+
+
+def test_fleet_report_counts_unreachable(tmp_path):
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+    from tools.statusz import fleet_report
+
+    st = Store(tmp_path / "fleet")
+    try:
+        with RestoreServer(RestoreRegistry(st), host="127.0.0.1") as srv:
+            rep = fleet_report(
+                [f"127.0.0.1:{srv.port}", "127.0.0.1:9"])
+            assert rep["hosts_up"] == 1 and rep["hosts_down"] == 1
+            assert rep["unreachable"][0]["host"] == "127.0.0.1:9"
+            host = rep["hosts"][0]
+            assert host["server"] == "restore"
+            assert isinstance(host["breakers_open"], list)
+    finally:
+        st.close()
+
+
+def test_fleet_cli_one_json_line(tmp_path):
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+
+    repo = Path(__file__).resolve().parent.parent
+    st = Store(tmp_path / "fleetcli")
+    try:
+        with RestoreServer(RestoreRegistry(st), host="127.0.0.1") as srv:
+            out = subprocess.run(
+                [sys.executable, "tools/statusz.py",
+                 "--fleet", f"127.0.0.1:{srv.port}"],
+                cwd=repo, capture_output=True, text=True, timeout=60)
+            assert out.returncode == 0, out.stderr
+            doc = json.loads(out.stdout.strip().splitlines()[-1])
+            assert doc["metric"] == "statusz_fleet"
+            assert doc["hosts_up"] == 1
+    finally:
+        st.close()

@@ -12,6 +12,7 @@ from tools.analyze.passes import (  # noqa: F401 — registration imports
     lock_order,
     log_hygiene,
     metric_hygiene,
+    swarm_policy,
     threads,
     wire_policy,
 )

@@ -1,0 +1,80 @@
+"""swarm-owner-only-origin: origin chunk fetches outside the scheduler.
+
+The swarm pull's aggregate-origin-bytes ≈ 1× contract holds ONLY because
+every origin chunk read goes through :class:`SwarmScheduler`'s ownership
+decision (owned → fetch, non-owned → cross-fill or succession). The
+origin transport is the module-level ``_swarm_origin_read`` choke in
+``demodel_tpu/sink/remote.py`` — a call to it from anywhere outside the
+``SwarmScheduler`` class body is an origin fetch that bypassed the
+ownership decision, which silently degrades a pod's swarm pull back
+toward N× origin traffic.
+
+Scope: files under ``demodel_tpu/sink/`` (where the swarm plane lives)
+plus any file carrying an explicit ``# demodel: swarm-plane`` pragma —
+which is how the golden fixture opts in, mirroring the wire-policy
+pragma convention. Covers the function imported under an alias
+(``from ... import _swarm_origin_read as orig``) and module-attribute
+calls (``remote._swarm_origin_read(...)``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analyze.core import Finding, ModuleContext, Pass, register
+
+_CHOKE = "_swarm_origin_read"
+_OWNER_CLASS = "SwarmScheduler"
+_PRAGMA = "# demodel: swarm-plane"
+
+
+def _enclosing_class(node: ast.AST) -> str | None:
+    cur = getattr(node, "_dm_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = getattr(cur, "_dm_parent", None)
+    return None
+
+
+@register
+class SwarmOriginPolicyPass(Pass):
+    id = "swarm-owner-only-origin"
+    description = (
+        "origin chunk fetch (_swarm_origin_read) outside SwarmScheduler "
+        "in sink/ — every swarm origin byte must route through the "
+        "scheduler's ownership decision or the aggregate-origin ≈ 1x "
+        "contract silently degrades to per-host origin pulls"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not (ctx.rel.startswith("demodel_tpu/sink/")
+                or _PRAGMA in ctx.source):
+            return
+        aliases: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name == _CHOKE:
+                        aliases.add(a.asname or a.name)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            named = (
+                (isinstance(fn, ast.Name)
+                 and (fn.id == _CHOKE or fn.id in aliases))
+                or (isinstance(fn, ast.Attribute) and fn.attr == _CHOKE)
+            )
+            if not named:
+                continue
+            if _enclosing_class(node) == _OWNER_CLASS:
+                continue
+            yield Finding(
+                ctx.rel, node.lineno, self.id,
+                f"{_CHOKE}() called outside SwarmScheduler — an origin "
+                "chunk fetch that bypasses the ownership decision "
+                "degrades the swarm's aggregate-origin-bytes contract; "
+                "route it through the scheduler (ensure/_fetch_origin)",
+            )

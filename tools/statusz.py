@@ -17,11 +17,17 @@ per-stage breakdown + error spans of the captured ring.
 ``--validate`` exits nonzero unless the source parses AND carries the
 statusz/recorder schema — the CI statusz-smoke gate.
 
+``--fleet host1,host2,...`` renders the POD view: one JSON line joining
+every host's statusz — per-host open breakers, swarm chunk progress, and
+the oldest in-flight span — the "which host is the slow one" answer for
+a pod-scale swarm pull, one command instead of N curls.
+
 Usage::
 
     python tools/statusz.py http://127.0.0.1:8800
     python tools/statusz.py /tmp/demodel-flightrec-4242-1.json
     python tools/statusz.py http://127.0.0.1:8800 --validate
+    python tools/statusz.py --fleet host-a:8800,host-b:8800,host-c:8800
 """
 
 from __future__ import annotations
@@ -102,6 +108,57 @@ def report(doc: dict, source: str) -> dict:
     return out
 
 
+def _oldest_inflight(flat: list[dict]) -> dict | None:
+    with_age = [e for e in flat if isinstance(e.get("age_sec"), (int, float))]
+    if not with_age:
+        return None
+    top = max(with_age, key=lambda e: e["age_sec"])
+    return {"name": top.get("name"), "age_sec": top.get("age_sec")}
+
+
+def fleet_report(hosts: list[str]) -> dict:
+    """The pod view: every host's statusz joined into one line. A host
+    that doesn't answer is reported, not fatal — the dead host is
+    usually the finding."""
+    out: dict = {"metric": "statusz_fleet", "hosts": [], "unreachable": []}
+    swarm_total = swarm_have = 0
+    for host in hosts:
+        source = host if host.startswith(("http://", "https://")) \
+            else f"http://{host}"
+        try:
+            doc, _url = load(source)
+        except Exception as e:  # noqa: BLE001 — per-host degrade is the point
+            out["unreachable"].append({"host": host, "error": str(e)})
+            continue
+        breakers = doc.get("breakers", {})
+        entry: dict = {
+            "host": host,
+            "server": doc.get("server"),
+            "uptime_sec": doc.get("uptime_sec"),
+            "breakers_open": [
+                {"peer": peer, **b} for peer, b in sorted(breakers.items())
+                if b.get("state") != "closed"],
+            "swarm": doc.get("swarm", []),
+            "oldest_inflight": _oldest_inflight(
+                _flatten_inflight(doc.get("inflight_spans", []))),
+        }
+        for b in entry["swarm"]:
+            swarm_total += int(b.get("chunks_total", 0))
+            swarm_have += int(b.get("chunks_have", 0))
+        if "conns" in doc:  # native proxy hosts
+            entry["conns"] = doc["conns"]
+        out["hosts"].append(entry)
+    out["hosts_up"] = len(out["hosts"])
+    out["hosts_down"] = len(out["unreachable"])
+    out["breakers_open_total"] = sum(
+        len(h["breakers_open"]) for h in out["hosts"])
+    if swarm_total:
+        out["swarm_progress"] = {
+            "chunks_have": swarm_have, "chunks_total": swarm_total,
+            "pct": round(100.0 * swarm_have / swarm_total, 1)}
+    return out
+
+
 def validate(doc: dict, source: str) -> None:
     """Schema gate for CI: the fields every consumer of this surface
     depends on must exist with the right shapes."""
@@ -114,7 +171,8 @@ def validate(doc: dict, source: str) -> None:
         raise SystemExit(f"{source}: missing/unknown statusz schema version")
     native = doc.get("server") == "demodel-native-proxy"
     required = (("config", "conns", "metrics") if native else
-                ("breakers", "budgets", "inflight_spans", "trace"))
+                ("breakers", "budgets", "inflight_spans", "trace",
+                 "swarm"))
     for key in required:
         if key not in doc:
             raise SystemExit(f"{source}: statusz missing {key!r}")
@@ -124,11 +182,24 @@ def validate(doc: dict, source: str) -> None:
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("source", help="statusz URL (http://host:port) or "
-                                   "flight-recorder dump path")
+    ap.add_argument("source", nargs="?",
+                    help="statusz URL (http://host:port) or "
+                         "flight-recorder dump path")
     ap.add_argument("--validate", action="store_true",
                     help="schema-check only (CI smoke); nonzero on failure")
+    ap.add_argument("--fleet", metavar="HOSTS",
+                    help="comma-separated host[:port] list — render the "
+                         "one-line pod view instead of a single source")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        hosts = [h.strip() for h in args.fleet.split(",") if h.strip()]
+        if not hosts:
+            ap.error("--fleet needs at least one host")
+        print(json.dumps(fleet_report(hosts), default=str))
+        return 0
+    if not args.source:
+        ap.error("a source (or --fleet) is required")
 
     doc, source = load(args.source)
     if args.validate:
