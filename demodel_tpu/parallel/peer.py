@@ -217,11 +217,11 @@ def _peer_streams() -> int:
     — but only when cores exist to run the streams: on a host with few
     CPUs the extra sockets just contend (measured −18% at 1 core, 8
     streams vs 1), so the unset-env default is clamped to the core
-    count. An explicit env value always wins."""
-    from demodel_tpu.utils.env import available_cpus
+    count. An explicit env value always wins. Resolution lives in
+    utils.env so the dep-light statusz surface reports the same value."""
+    from demodel_tpu.utils.env import default_peer_streams
 
-    return env_int("DEMODEL_PEER_STREAMS", max(1, min(8, available_cpus())),
-                   minimum=1)
+    return default_peer_streams()
 
 
 @dataclass

@@ -122,8 +122,36 @@ def chunk_span(size: int, chunk_bytes: int, index: int) -> tuple[int, int]:
     return off, min(chunk_bytes, int(size) - off)
 
 
+# Knob defaults delegate to utils.env (the shared stdlib-only home) so
+# the dep-light statusz effective-config surface reports the same
+# defaults the scheduler actually uses — a copied literal drifts, a
+# shared resolver cannot (the FILL_TIMEOUT 15-vs-60 doc bug in PR 8 was
+# exactly that drift). Importing THIS module still runs the parallel
+# package's __init__ (jax), which is why statusz reads utils.env, not us.
+
+
 def default_chunk_bytes() -> int:
-    return env_int("DEMODEL_SWARM_CHUNK_MB", 8, minimum=1) << 20
+    from demodel_tpu.utils.env import default_swarm_chunk_mb
+
+    return default_swarm_chunk_mb() << 20
+
+
+def default_fill_timeout() -> float:
+    from demodel_tpu.utils.env import default_swarm_fill_timeout
+
+    return default_swarm_fill_timeout()
+
+
+def default_origin_streams() -> int:
+    from demodel_tpu.utils.env import default_swarm_origin_streams
+
+    return default_swarm_origin_streams()
+
+
+def reap_enabled() -> bool:
+    from demodel_tpu.utils.env import swarm_reap_enabled
+
+    return swarm_reap_enabled()
 
 
 def _bitmap_hex(have: set[int], n: int) -> str:
@@ -158,6 +186,12 @@ class ChunkBoard:
         self._lock = threading.Lock()
         self._files: dict[str, int] = {}          # file key → chunk count
         self._chunks: dict[tuple[str, int], bytes] = {}
+        #: chunks the reaper freed: landed once, bytes dropped because
+        #: every live sibling already holds them — progress accounting
+        #: keeps them (the chunk DID cross the wire), the serve surface
+        #: and the summary bitmap do not (we can no longer serve them)
+        self._reaped: set[tuple[str, int]] = set()
+        self._bytes_reaped = 0
         self._version = 0
 
     def add_file(self, key: str, n_chunks: int) -> None:
@@ -170,6 +204,7 @@ class ChunkBoard:
             if key not in self._files:
                 raise KeyError(f"unknown swarm file {key!r}")
             self._chunks[(key, index)] = bytes(data)
+            self._reaped.discard((key, index))  # a re-fetch un-reaps
             self._version += 1
 
     def get(self, key: str, index: int) -> bytes | None:
@@ -180,9 +215,44 @@ class ChunkBoard:
         with self._lock:
             return (key, index) in self._chunks
 
+    def done(self, key: str, index: int) -> bool:
+        """Held OR reaped — the pumps' "nothing left to fetch" check (a
+        reaped chunk must not be re-pulled just to be re-freed)."""
+        with self._lock:
+            return (key, index) in self._chunks \
+                or (key, index) in self._reaped
+
+    def reaped(self, key: str, index: int) -> bool:
+        with self._lock:
+            return (key, index) in self._reaped
+
+    def reap(self, key: str, index: int) -> int:
+        """Free one chunk's bytes (returns how many; 0 when not held).
+        The possession bit moves to the reaped set: progress stats keep
+        counting it, the summary stops advertising it."""
+        with self._lock:
+            data = self._chunks.pop((key, index), None)
+            if data is None:
+                return 0
+            self._reaped.add((key, index))
+            self._bytes_reaped += len(data)
+            self._version += 1
+            return len(data)
+
+    def unreap(self, key: str, index: int) -> None:
+        """A local reader needs a reaped chunk after all: clear the flag
+        so the acquisition path (origin/peer fetch) claims it again."""
+        with self._lock:
+            self._reaped.discard((key, index))
+
     def have(self, key: str) -> set[int]:
         with self._lock:
             return {i for (k, i) in self._chunks if k == key}
+
+    def held(self) -> list[tuple[str, int]]:
+        """Every chunk currently holding bytes (the reaper's scan set)."""
+        with self._lock:
+            return list(self._chunks)
 
     def version(self) -> int:
         with self._lock:
@@ -191,15 +261,24 @@ class ChunkBoard:
     def summary(self) -> dict:
         """Bounded, versioned possession advertisement: one bitmap per
         file (n/8 bytes hex), never the chunk list — a 13 GB manifest at
-        8 MB chunks is a ~208-byte bitmap."""
+        8 MB chunks is a ~208-byte bitmap. ``have`` is what this host
+        can SERVE right now; ``done`` additionally includes reaped
+        chunks (landed once, bytes freed) — siblings gate their own
+        reaps on ``done``, never ``have``, or the first host to reap a
+        chunk would block every later host from ever reaping it."""
         with self._lock:
             return {
                 "pull": self.pull_id,
                 "host": self.host_id,
                 "v": self._version,
                 "files": {
-                    k: {"n": n, "have": _bitmap_hex(
-                        {i for (fk, i) in self._chunks if fk == k}, n)}
+                    k: {"n": n,
+                        "have": _bitmap_hex(
+                            {i for (fk, i) in self._chunks if fk == k}, n),
+                        "done": _bitmap_hex(
+                            {i for (fk, i) in self._chunks if fk == k}
+                            | {i for (fk, i) in self._reaped if fk == k},
+                            n)}
                     for k, n in self._files.items()
                 },
             }
@@ -210,8 +289,12 @@ class ChunkBoard:
             return {
                 "pull": self.pull_id, "host": self.host_id,
                 "files": len(self._files), "chunks_total": total,
-                "chunks_have": len(self._chunks),
+                # progress counts reaped chunks (they DID land; reaping
+                # is a memory release, not lost work)
+                "chunks_have": len(self._chunks) + len(self._reaped),
                 "bytes_held": sum(len(b) for b in self._chunks.values()),
+                "chunks_reaped": len(self._reaped),
+                "bytes_reaped": self._bytes_reaped,
                 "v": self._version,
             }
 
@@ -219,6 +302,7 @@ class ChunkBoard:
         with self._lock:
             self._chunks.clear()
             self._files.clear()
+            self._reaped.clear()
             self._version += 1
 
 

@@ -462,6 +462,14 @@ def make_handler(registry: RestoreRegistry, proxy=None):
                 body = metrics.render(proxy=proxy, store=registry.store).encode()
                 self._send(200, body, ctype="text/plain; version=0.0.4")
                 return
+            if self.path == "/debug/telemetry":
+                # the time-series view: 30 s / 5 min sliding-window rates
+                # and delta-bucket quantiles over the Python hub, plus the
+                # native proxy's scrape-diffed mirror when one is attached
+                doc = metrics.telemetry_doc(proxy=proxy)
+                doc["server"] = "restore"
+                self._send(200, json.dumps(doc, default=str).encode())
+                return
             if self.path == "/debug/statusz":
                 # live introspection: open breakers, budget charge,
                 # in-flight span tree, flight-recorder state — "what is

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import os
 import threading
 import time
 
@@ -176,6 +177,9 @@ class PeerBlobReader:
         if n:
             with self._count_lock:
                 self.bytes_fetched += n
+            # the delivery-rate counter the adaptive tuner (and anyone
+            # watching /debug/telemetry) reads as a sliding-window rate
+            metrics.HUB.inc("pull_bytes_total", n)
 
     # -- Store duck-type ------------------------------------------------
     def size(self, key: str) -> int:  # noqa: ARG002 — single-object reader
@@ -629,8 +633,7 @@ class SwarmScheduler:
         #: whole owned share's origin time to appear, so a small value
         #: here re-fetches healthy hosts' chunks and erodes the 1×
         #: origin contract
-        self._fill_timeout = float(env_int(
-            "DEMODEL_SWARM_FILL_TIMEOUT", 60, minimum=1))
+        self._fill_timeout = swarm_placement.default_fill_timeout()
         self._gossip_s = env_int(
             "DEMODEL_SWARM_GOSSIP_MS", 500, minimum=10) / 1000.0
         self._fill_streams = env_int(
@@ -640,8 +643,8 @@ class SwarmScheduler:
         #: contract bounds each host's origin LINK use, so the default
         #: is one stream — multi-stream parallelism belongs inside a
         #: window (DEMODEL_PEER_STREAMS), not across origin chunks
-        self._origin_sem = threading.Semaphore(env_int(
-            "DEMODEL_SWARM_ORIGIN_STREAMS", 1, minimum=1))
+        self._origin_sem = threading.Semaphore(
+            swarm_placement.default_origin_streams())
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         #: file key → (size, n_chunks, origin PeerBlobReader)
@@ -650,12 +653,25 @@ class SwarmScheduler:
         self._owned: list[tuple[str, int]] = []
         self._inflight: set[tuple[str, int]] = set()
         self._peer_have: dict[str, dict[str, set[int]]] = {}
+        #: gossiped done-sets (have ∪ reaped) per sibling — the reap
+        #: gate; _peer_have stays strictly what a sibling can SERVE
+        self._peer_done: dict[str, dict[str, set[int]]] = {}
         self._peer_ver: dict[str, int] = {}
         self._poll_fails: dict[str, int] = {}
         self._dead: set[str] = set()
         self._peer_bytes: dict[str, int] = {}   # file key → peer-fill bytes
         self._spread: dict[tuple[str, int], int] = {}  # rarest tie-break
         self.chunks_refetched = 0
+        #: offsets of in-flight read_into calls per file — the reaper
+        #: never frees below an active read's start
+        self._active_reads: dict[str, list[int]] = {}
+        #: per-file local consumption watermark (highest byte offset a
+        #: read_into has fully passed) — the reaper only frees chunks the
+        #: local delivery is already beyond, so a long pull's board stops
+        #: retaining the whole file set until close()
+        self._consumed_upto: dict[str, int] = {}
+        self._reap = swarm_placement.reap_enabled()
+        self._reap_s = max(2 * self._gossip_s, 0.5)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._tls = threading.local()
@@ -702,6 +718,9 @@ class SwarmScheduler:
         self._plan()
         self._threads.append(threading.Thread(
             target=self._pump_origin, name="swarm-pump", daemon=True))
+        if self._reap:
+            self._threads.append(threading.Thread(
+                target=self._pump_reap, name="swarm-reap", daemon=True))
         if len(self.participants) > 1:
             self._threads.append(threading.Thread(
                 target=self._pump_gossip, name="swarm-gossip", daemon=True))
@@ -740,15 +759,33 @@ class SwarmScheduler:
         if offset < 0 or offset + length > size:
             raise IOError(f"swarm window [{offset}, {offset + length}) "
                           f"outside {key} of {size} bytes")
-        pos = 0
-        while pos < length:
-            idx = (offset + pos) // self.chunk_bytes
-            c_off, c_len = chunk_span(size, self.chunk_bytes, idx)
-            data = self.ensure(key, idx)
-            lo = offset + pos - c_off
-            take = min(c_len - lo, length - pos)
-            view[pos:pos + take] = data[lo:lo + take]
-            pos += take
+        # register as an in-flight read: the reaper's safe-to-free floor
+        # is min(active read starts, completed high-water) — prefetch
+        # workers complete out of order as the norm, and a reap under a
+        # still-running lower-offset read would force an origin re-fetch
+        with self._lock:
+            self._active_reads.setdefault(key, []).append(offset)
+        try:
+            pos = 0
+            while pos < length:
+                idx = (offset + pos) // self.chunk_bytes
+                c_off, c_len = chunk_span(size, self.chunk_bytes, idx)
+                data = self.ensure(key, idx)
+                lo = offset + pos - c_off
+                take = min(c_len - lo, length - pos)
+                view[pos:pos + take] = data[lo:lo + take]
+                pos += take
+        finally:
+            with self._lock:
+                self._active_reads[key].remove(offset)
+        # completed-read high-water: delivery walks files in (mostly)
+        # ascending offset order, so chunks wholly below it — and below
+        # every still-active read — are done locally; a rare later
+        # re-read of a reaped chunk degrades to one counted re-fetch,
+        # never a wrong byte
+        with self._lock:
+            if offset + length > self._consumed_upto.get(key, 0):
+                self._consumed_upto[key] = offset + length
         return length
 
     def fetch_all(self) -> None:
@@ -782,6 +819,19 @@ class SwarmScheduler:
             data = self.board.get(key, index)
             if data is not None:
                 return data
+            if self.board.reaped(key, index):
+                # a local re-read below the consumption watermark wants a
+                # chunk the reaper freed: re-land it from origin OURSELVES.
+                # The chunk already crossed the wire once, and the live
+                # siblings have likely reaped it too (reaping requires
+                # every one of them to have advertised it) — the
+                # owner-wait path below would stall out the fill timeout
+                # and falsely condemn a healthy owner that simply cannot
+                # serve a chunk it also freed.
+                self.board.unreap(key, index)
+                metrics.HUB.inc("swarm_chunks_unreaped_total")
+                self._fetch_origin(key, index, reowned=False)
+                continue
             live = [o for o in owners if o not in self._snapshot_dead()]
             target = live[0] if live else self.self_id
             if target == self.self_id:
@@ -826,7 +876,7 @@ class SwarmScheduler:
     def _claim(self, key: str, index: int) -> bool:
         with self._lock:
             if (key, index) in self._inflight \
-                    or self.board.has(key, index):
+                    or self.board.done(key, index):
                 return False
             self._inflight.add((key, index))
             return True
@@ -925,7 +975,7 @@ class SwarmScheduler:
             with self._lock:
                 remaining = [c for c in self._owned
                              if c not in self._inflight
-                             and not self.board.has(*c)]
+                             and not self.board.done(*c)]
                 # one possession snapshot per pick, not one lock-held
                 # _advertisers() scan per candidate: a 13 GB manifest is
                 # ~1700 owned chunks on a solo host and re-scoring the
@@ -1003,6 +1053,15 @@ class SwarmScheduler:
                                        int(spec.get("n", 0)))
                 for k, spec in files.items() if isinstance(spec, dict)
             }
+            # done ⊇ have: landed-at-least-once (reaped included) — the
+            # reap gate. A summary without it (older sibling) degrades
+            # to have, which merely delays our reap, never corrupts
+            done = {
+                str(k): bitmap_indices(str(spec.get("done",
+                                                    spec.get("have", ""))),
+                                       int(spec.get("n", 0)))
+                for k, spec in files.items() if isinstance(spec, dict)
+            }
         except (TypeError, ValueError, AttributeError):
             return  # junk gossip degrades to nothing, never a crash
         with self._cv:
@@ -1015,6 +1074,7 @@ class SwarmScheduler:
                 return  # stale reordering
             self._peer_ver[host] = version
             self._peer_have[host] = have
+            self._peer_done[host] = done
             self._poll_fails[host] = 0
             if host in self._dead:
                 # resurrection: chunks already taken over stay ours
@@ -1066,6 +1126,55 @@ class SwarmScheduler:
         log.info("swarm succession: taking over %d orphaned chunk(s) "
                  "from dead sibling(s) %s", len(takeover), sorted(dead))
 
+    def _pump_reap(self) -> None:
+        """The chunk-board reaper (ROADMAP swarm item b): periodically
+        frees chunks that (a) EVERY live sibling already advertises
+        possessing — the possession data is already gossiped, so nobody
+        will ask us for them — and (b) the local delivery has consumed
+        past, so a long pull's board stops retaining the whole file set
+        until close(). A solo board (no siblings) reaps on consumption
+        alone: there is no swarm left to serve."""
+        while not self._stop.is_set():
+            self._stop.wait(self._reap_s)
+            if self._stop.is_set():
+                return
+            for key, index in self._reap_candidates():
+                freed = self.board.reap(key, index)
+                if freed:
+                    metrics.HUB.inc("swarm_chunks_reaped_total")
+                    metrics.HUB.inc("swarm_bytes_reaped_total", freed)
+
+    def _reap_candidates(self) -> list[tuple[str, int]]:
+        with self._lock:
+            live = [h for h in self.participants
+                    if h != self.self_id and h not in self._dead]
+            # gate on the gossiped DONE sets (have ∪ reaped): a sibling
+            # that reaped first stops ADVERTISING a chunk, and gating on
+            # its have-set would block everyone who consumes later from
+            # ever reaping (the normal case in a skewed pod)
+            peer_done = {h: self._peer_done.get(h, {}) for h in live}
+            sizes = {k: s for k, (s, _n, _r) in self._files.items()}
+            consumed = dict(self._consumed_upto)
+            # an in-flight read at offset s may still need chunks ≥ s:
+            # prefetch workers complete out of order as the NORM, so the
+            # completed-read high-water alone would reap under a slower
+            # low-offset job and force counted origin re-fetches
+            floors = {k: min(starts) for k, starts
+                      in self._active_reads.items() if starts}
+        out = []
+        for key, index in self.board.held():
+            size = sizes.get(key)
+            if size is None:
+                continue
+            c_off, c_len = chunk_span(size, self.chunk_bytes, index)
+            safe_upto = min(consumed.get(key, 0),
+                            floors.get(key, float("inf")))
+            if c_off + c_len > safe_upto:
+                continue  # local delivery may still need it
+            if all(index in peer_done[h].get(key, ()) for h in live):
+                out.append((key, index))
+        return out
+
     def _pump_fill(self) -> None:
         """Cross-fill any advertised, non-local, non-owned chunk — the
         keep-the-pipe-full role; ensure() only ever waits for chunks the
@@ -1081,7 +1190,7 @@ class SwarmScheduler:
                             continue
                         for i in sorted(idxs):
                             if (key, i) not in self._inflight \
-                                    and not self.board.has(key, i):
+                                    and not self.board.done(key, i):
                                 target = (key, i)
                                 break
                         if target:
@@ -1172,6 +1281,7 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
     from concurrent.futures import ThreadPoolExecutor
 
     from demodel_tpu.formats.safetensors import _np_dtype
+    from demodel_tpu.sink import tuner as tuner_mod
     from demodel_tpu.sink.hbm import place_tensor
     from demodel_tpu.sink.streaming import ByteBudget
 
@@ -1213,6 +1323,14 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
     admission = {"next": 0, "dead": False}
     admit_cv = threading.Condition()
 
+    # the closed loop: an AIMD controller reads the live windowed
+    # telemetry (window-read p99, retry rate, budget-wait share, delivery
+    # rate) and moves streams / window size / prefetch depth between
+    # windows — DEMODEL_TUNER=0 keeps every knob at its fixed default
+    tuner = (tuner_mod.PullTuner(budget=budget,
+                                 prefetch_depth=prefetch_depth).start()
+             if tuner_mod.tuner_enabled() else None)
+
     def fetch(job, idx):
         reader, key, name, spec = job
         nbytes = spec.end - spec.start
@@ -1237,7 +1355,8 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
                         admit_cv.notify_all()
             try:
                 buf = np.empty(nbytes, dtype=np.uint8)
-                reader.pread_into(key, buf, spec.start)
+                tuner_mod.fetch_windows(reader, key, buf, spec.start,
+                                        tuner)
             except BaseException:
                 budget.release(nbytes)
                 raise
@@ -1273,23 +1392,31 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
     if prefetch_depth == 0:
         # thread-free: fetch inline, place, next — the fastest shape
         # when there is no core to hide the fetch on
-        for i, (reader, key, name, spec) in enumerate(jobs):
-            t0 = time.perf_counter()
-            try:
-                buf = fetch((reader, key, name, spec), i)
-            except OSError as e:
-                raise PipelineFailure(e, out) from e
-            t1 = time.perf_counter()
-            try:
-                place(buf, name, spec)
-            finally:
-                budget.release(buf.nbytes)
-            t2 = time.perf_counter()
-            phases[fetch_key] += t1 - t0
-            phases["place_secs"] += t2 - t1
+        try:
+            for i, (reader, key, name, spec) in enumerate(jobs):
+                t0 = time.perf_counter()
+                try:
+                    buf = fetch((reader, key, name, spec), i)
+                except OSError as e:
+                    raise PipelineFailure(e, out) from e
+                t1 = time.perf_counter()
+                try:
+                    place(buf, name, spec)
+                finally:
+                    budget.release(buf.nbytes)
+                t2 = time.perf_counter()
+                phases[fetch_key] += t1 - t0
+                phases["place_secs"] += t2 - t1
+        finally:
+            if tuner is not None:
+                tuner.stop()
         return out
 
-    with ThreadPoolExecutor(max_workers=prefetch_depth) as ex:
+    # with a live tuner the pool is sized to the prefetch CEILING and the
+    # submit loop keeps only the tuner's CURRENT depth in flight — depth
+    # changes apply between jobs, never mid-fetch
+    pool_size = tuner.max_prefetch if tuner is not None else prefetch_depth
+    with ThreadPoolExecutor(max_workers=max(1, pool_size)) as ex:
         # the try must live INSIDE the `with`: on an exception the
         # executor's __exit__ joins its workers during unwinding, so a
         # worker blocked in budget.acquire has to be woken by abort()
@@ -1298,8 +1425,19 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
         try:
             # trace.wrap: executor threads don't inherit contextvars, so
             # capture the pull span's context at the submit site
-            pending = [ex.submit(trace.wrap(fetch), j, d)
-                       for d, j in enumerate(jobs[:prefetch_depth])]
+            pending: list = []
+            next_job = 0
+
+            def top_up() -> None:
+                nonlocal next_job
+                depth = (max(1, min(tuner.prefetch_depth, pool_size))
+                         if tuner is not None else prefetch_depth)
+                while len(pending) < depth and next_job < len(jobs):
+                    pending.append(ex.submit(trace.wrap(fetch),
+                                             jobs[next_job], next_job))
+                    next_job += 1
+
+            top_up()
             for i, (reader, key, name, spec) in enumerate(jobs):
                 t0 = time.perf_counter()
                 try:
@@ -1312,10 +1450,7 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
                         p.cancel()
                     raise PipelineFailure(e, out) from e
                 t1 = time.perf_counter()
-                nxt = i + prefetch_depth
-                if nxt < len(jobs):
-                    pending.append(ex.submit(trace.wrap(fetch),
-                                             jobs[nxt], nxt))
+                top_up()
                 try:
                     place(buf, name, spec)
                 finally:
@@ -1331,6 +1466,9 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
                 admission["dead"] = True
                 admit_cv.notify_all()
             raise
+        finally:
+            if tuner is not None:
+                tuner.stop()
     return out
 
 

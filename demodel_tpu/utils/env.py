@@ -47,6 +47,18 @@ def env_bool(name: str, default: bool = False) -> bool:
     return default
 
 
+def env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("%s=%r is not a float; using default %s", name, raw,
+                    default)
+        return default
+
+
 def available_cpus() -> int:
     """CPUs this process may actually run on — sched_getaffinity sees
     cgroup/affinity limits (a container pinned to 1 CPU on a 64-core
@@ -57,3 +69,54 @@ def available_cpus() -> int:
         return len(os.sched_getaffinity(0)) or 1
     except (AttributeError, OSError):
         return os.cpu_count() or 1
+
+
+# ---- pull/swarm-plane knob defaults ----------------------------------
+#
+# These resolve HERE (stdlib-only) rather than in their consuming
+# modules because the statusz effective-config surface must report them
+# dep-light: importing parallel.peer, parallel.placement, or sink.tuner
+# runs those packages' __init__ and drags in jax — a statusz scrape must
+# never do that. The consumers (peer._peer_streams, placement, tuner)
+# delegate to these, so there is exactly one copy of each default.
+
+
+def default_peer_streams() -> int:
+    """``DEMODEL_PEER_STREAMS``: connections per large-object peer
+    transfer. The unset default clamps to the core count — extra sockets
+    on a 1-core host just contend (measured −18% at 1 core, 8 streams);
+    an explicit env value always wins."""
+    return env_int("DEMODEL_PEER_STREAMS",
+                   max(1, min(8, available_cpus())), minimum=1)
+
+
+def default_pull_window_mb() -> int:
+    """``DEMODEL_PULL_WINDOW_MB``: fetch window granularity (default 32
+    — large enough to amortize per-window overhead, small enough that
+    one flaky window's retry cost stays bounded)."""
+    return env_int("DEMODEL_PULL_WINDOW_MB", 32, minimum=1)
+
+
+def tuner_enabled() -> bool:
+    """``DEMODEL_TUNER``: the adaptive pull tuner switch — on unless
+    explicitly disabled (=0 restores the fixed env defaults)."""
+    return env_bool("DEMODEL_TUNER", True)
+
+
+def default_swarm_chunk_mb() -> int:
+    return env_int("DEMODEL_SWARM_CHUNK_MB", 8, minimum=1)
+
+
+def default_swarm_fill_timeout() -> float:
+    return float(env_int("DEMODEL_SWARM_FILL_TIMEOUT", 60, minimum=1))
+
+
+def default_swarm_origin_streams() -> int:
+    return env_int("DEMODEL_SWARM_ORIGIN_STREAMS", 1, minimum=1)
+
+
+def swarm_reap_enabled() -> bool:
+    """``DEMODEL_SWARM_REAP``=0 keeps the pre-reaper retain-until-
+    close() board behavior (e.g. a warm standby that WANTS to keep
+    serving)."""
+    return env_bool("DEMODEL_SWARM_REAP", True)

@@ -149,6 +149,14 @@ def _default_base_delay() -> float:
     return env_int("DEMODEL_RETRY_BASE_MS", 100, minimum=1) / 1000.0
 
 
+def default_breaker_threshold() -> int:
+    return env_int("DEMODEL_BREAKER_THRESHOLD", 3, minimum=1)
+
+
+def default_breaker_cooldown() -> float:
+    return float(env_int("DEMODEL_BREAKER_COOLDOWN", 15, minimum=1))
+
+
 @dataclass
 class RetryPolicy:
     """Exponential backoff with full jitter, capped by attempts AND a
@@ -370,16 +378,19 @@ class PeerHealth:
     """Process-wide breaker registry, shared by every wire caller so one
     component's failures protect every other component's critical path."""
 
+    # (defaults resolve through module helpers below so the statusz
+    # effective-config surface reports the values this class really uses)
+
     _shared: ClassVar["PeerHealth | None"] = None
     _shared_lock: ClassVar[threading.Lock] = threading.Lock()
 
     def __init__(self, threshold: int | None = None,
                  cooldown: float | None = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
-        self.threshold = threshold if threshold is not None else env_int(
-            "DEMODEL_BREAKER_THRESHOLD", 3, minimum=1)
-        self.cooldown = cooldown if cooldown is not None else float(env_int(
-            "DEMODEL_BREAKER_COOLDOWN", 15, minimum=1))
+        self.threshold = (threshold if threshold is not None
+                          else default_breaker_threshold())
+        self.cooldown = (cooldown if cooldown is not None
+                         else default_breaker_cooldown())
         self._clock = clock
         self._lock = threading.Lock()
         self._breakers: dict[str, CircuitBreaker] = {}

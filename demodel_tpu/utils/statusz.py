@@ -76,6 +76,103 @@ def _gossip() -> dict[str, Any]:
     return out
 
 
+def _active_tuner() -> Any:
+    """The live adaptive-pull tuner, if one is running (``sys.modules``
+    peek — never allocates; a scrape must observe the tuner registry,
+    not create it)."""
+    tuner = sys.modules.get("demodel_tpu.sink.tuner")
+    if tuner is None:
+        return None
+    return tuner.current()
+
+
+#: the tunable knobs every plane reports effectively-resolved — "what is
+#: this node actually running with" must never require reading env docs.
+#: Every value resolves through a shared resolver (never a copied
+#: literal, which silently drifts the moment the owner changes — exactly
+#: the FILL_TIMEOUT 15-vs-60 doc bug PR 8 had to fix) living in a
+#: jax-free module: placement for the swarm knobs, utils.env for the
+#: pull-plane knobs (importing parallel.peer or sink.tuner would run
+#: their packages' __init__ and drag jax into a dep-light scrape).
+def _knob_rows() -> list[tuple[str, Any]]:
+    from demodel_tpu.utils import env, faults
+    from demodel_tpu.utils.env import (
+        default_peer_streams,
+        default_pull_window_mb,
+        env_int,
+        tuner_enabled,
+    )
+    from demodel_tpu.utils.metrics import _telemetry_ring_cap
+
+    return [
+        ("DEMODEL_PEER_STREAMS", default_peer_streams()),
+        ("DEMODEL_SINK_PREFETCH",
+         # the unset default is backend-dependent (resolved at pull time
+         # in sink.remote) — report "auto" instead of importing jax here
+         env_int("DEMODEL_SINK_PREFETCH", -1, minimum=0)
+         if os.environ.get("DEMODEL_SINK_PREFETCH", "").strip()
+         else "auto"),
+        ("DEMODEL_PULL_WINDOW_MB", default_pull_window_mb()),
+        ("DEMODEL_SINK_BUFFER_MB",
+         # the one literal left: the owner (sink.streaming) resolves it
+         # inline and is numpy-heavy — keep the default in sync
+         env_int("DEMODEL_SINK_BUFFER_MB", 1024, minimum=1)),
+        ("DEMODEL_RETRY_MAX", faults._default_max_attempts()),
+        ("DEMODEL_RETRY_DEADLINE", int(faults._default_deadline())),
+        ("DEMODEL_BREAKER_THRESHOLD", faults.default_breaker_threshold()),
+        ("DEMODEL_BREAKER_COOLDOWN",
+         int(faults.default_breaker_cooldown())),
+        ("DEMODEL_SWARM_CHUNK_MB", env.default_swarm_chunk_mb()),
+        ("DEMODEL_SWARM_FILL_TIMEOUT",
+         int(env.default_swarm_fill_timeout())),
+        ("DEMODEL_SWARM_ORIGIN_STREAMS",
+         env.default_swarm_origin_streams()),
+        ("DEMODEL_SWARM_REAP", env.swarm_reap_enabled()),
+        ("DEMODEL_TUNER", tuner_enabled()),
+        ("DEMODEL_TELEMETRY_RING", _telemetry_ring_cap()),
+    ]
+
+
+#: env knob → the live tuner attribute that may be overriding it
+_TUNED_KNOBS = {
+    "DEMODEL_PEER_STREAMS": "streams",
+    "DEMODEL_PULL_WINDOW_MB": "window_mb",
+    "DEMODEL_SINK_PREFETCH": "prefetch_depth",
+}
+
+
+def effective_config() -> dict[str, dict[str, Any]]:
+    """Each tunable knob's EFFECTIVE value and where it came from:
+    ``tuner`` (a live adaptive tuner is overriding it), ``env`` (the
+    operator pinned it), or ``default``."""
+    tuner = _active_tuner()
+    out: dict[str, dict[str, Any]] = {}
+    for env_var, resolved in _knob_rows():
+        source = "env" if os.environ.get(env_var, "").strip() else "default"
+        value: Any = resolved
+        attr = _TUNED_KNOBS.get(env_var)
+        if tuner is not None and attr is not None:
+            tuned = getattr(tuner, attr, None)
+            if tuned is not None:
+                value, source = tuned, "tuner"
+        out[env_var] = {"value": value, "source": source}
+    return out
+
+
+def _telemetry_summary() -> dict[str, Any]:
+    """The statusz-sized slice of the telemetry plane: windowed p99s per
+    histogram family (the full document lives at ``/debug/telemetry``)."""
+    tel = metrics.HUB.telemetry().summary()
+    return {
+        "snapshots": tel["snapshots"],
+        "windows_s": tel["windows_s"],
+        "p99": {
+            name: {w: windows[w]["p99"] for w in windows}
+            for name, windows in tel["hist"].items()
+        },
+    }
+
+
 def snapshot(extra: dict[str, Any] | None = None) -> dict[str, Any]:
     """The statusz document. ``extra`` lets a server add its own section
     (registered models, bind address) without forking the schema."""
@@ -100,6 +197,8 @@ def snapshot(extra: dict[str, Any] | None = None) -> dict[str, Any]:
         "budgets": _budgets(),
         "swarm": _swarm(),
         "gossip": _gossip(),
+        "config": effective_config(),
+        "telemetry": _telemetry_summary(),
         "counters": metrics.HUB.snapshot(),
         "gauges": metrics.HUB.gauges(),
     }

@@ -19,6 +19,7 @@
 #include <cctype>
 #include <cerrno>
 #include <chrono>
+#include <cmath>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -808,6 +809,21 @@ class Session {
         // map + fill counts, and the full metrics JSON incl. histograms
         route_set(kRouteStatusz);
         std::string body = p_->statusz_json();
+        char head[256];
+        ::snprintf(head, sizeof head,
+                   "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                   "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                   body.size());
+        route_ttfb();
+        client_.writev_all(head, ::strlen(head), body.data(), body.size());
+        return false;
+      }
+      if (req.target == "/debug/telemetry") {
+        // the time-series twin of statusz: sliding-window rates and
+        // delta-bucket p50/p99 per route, poll-driven (each request
+        // may append one snapshot to the bounded ring)
+        route_set(kRouteStatusz);
+        std::string body = p_->telemetry_json();
         char head[256];
         ::snprintf(head, sizeof head,
                    "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
@@ -2366,6 +2382,131 @@ std::string Proxy::statusz_json() {
   std::string out = buf;
   out.append(metrics_json());
   out.append("}");
+  return out;
+}
+
+// ---- telemetry time series -------------------------------------------
+
+static const char *const kTelemetryFamilyNames[] = {
+    "serve_request_seconds", "serve_ttfb_seconds", "upstream_ttfb_seconds"};
+
+// Upper-bound quantile over a DELTA bucket vector — the C++ twin of
+// utils/metrics.hist_quantile, so windowed p99s agree bucket-for-bucket
+// with the Python side. +Inf hits report the largest finite bound.
+static double delta_quantile(const uint64_t *counts, double q) {
+  uint64_t total = 0;
+  for (int i = 0; i <= Hist::kBuckets; i++) total += counts[i];
+  if (total == 0) return 0.0;
+  double rank = q * static_cast<double>(total);
+  if (rank < 1.0) rank = 1.0;
+  uint64_t seen = 0;
+  for (int i = 0; i <= Hist::kBuckets; i++) {
+    seen += counts[i];
+    if (static_cast<double>(seen) >= rank && counts[i]) {
+      return Hist::bound(i < Hist::kBuckets ? i : Hist::kBuckets - 1);
+    }
+  }
+  return Hist::bound(Hist::kBuckets - 1);
+}
+
+std::string Proxy::telemetry_json() {
+  using std::chrono::duration;
+  const Hist *families[kTelemetryFamilies] = {
+      metrics_.route_latency, metrics_.route_ttfb,
+      metrics_.route_upstream_ttfb};
+  double now = duration<double>(
+                   std::chrono::steady_clock::now().time_since_epoch())
+                   .count();
+  // same knob names AND defaults as the Python plane's Telemetry ring
+  // (utils/metrics.py) — the two surfaces claim to mirror each other,
+  // so one logical knob must not resolve differently per plane
+  int min_ms = env_pos_int("DEMODEL_TELEMETRY_MIN_GAP_MS", 600000);
+  if (min_ms == 0) min_ms = 250;
+  int cap = env_pos_int("DEMODEL_TELEMETRY_RING");
+  if (cap == 0) cap = 360;
+
+  std::lock_guard<Mutex> g(telemetry_mu_);
+  if (telemetry_ring_.empty() ||
+      now - telemetry_ring_.back().ts >= min_ms / 1000.0) {
+    TelemetrySnap snap;
+    snap.ts = now;
+    snap.wall = static_cast<double>(::time(nullptr));
+    for (int f = 0; f < kTelemetryFamilies; f++) {
+      for (int r = 0; r < kRouteCount; r++) {
+        uint64_t sum_ns = families[f][r].sum_ns.load(
+            std::memory_order_relaxed);
+        snap.sums[f][r] = static_cast<double>(sum_ns) / 1e9;
+        for (int i = 0; i <= Hist::kBuckets; i++) {
+          snap.counts[f][r][i] =
+              families[f][r].buckets[i].load(std::memory_order_relaxed);
+        }
+      }
+    }
+    telemetry_ring_.push_back(snap);
+    while (telemetry_ring_.size() > static_cast<size_t>(cap))
+      telemetry_ring_.pop_front();
+  }
+
+  const TelemetrySnap &newest = telemetry_ring_.back();
+  char buf[256];
+  ::snprintf(buf, sizeof buf,
+             "{\"telemetry\":1,\"server\":\"demodel-native-proxy\","
+             "\"time\":%.3f,\"snapshots\":%zu,\"windows_s\":[30,300],"
+             "\"windows\":{",
+             newest.wall, telemetry_ring_.size());
+  std::string out = buf;
+  const int kWindows[2] = {30, 300};
+  for (int w = 0; w < 2; w++) {
+    if (w) out.append(",");
+    ::snprintf(buf, sizeof buf, "\"%d\":{", kWindows[w]);
+    out.append(buf);
+    // baseline: the ring entry closest to now-window (never the newest
+    // itself) — a short ring truncates the window honestly, and a
+    // single-entry ring yields an empty window
+    const TelemetrySnap *base = nullptr;
+    double target = newest.ts - kWindows[w];
+    for (size_t i = 0; i + 1 < telemetry_ring_.size(); i++) {
+      const TelemetrySnap &s = telemetry_ring_[i];
+      if (base == nullptr ||
+          std::abs(s.ts - target) < std::abs(base->ts - target)) {
+        base = &s;
+      }
+    }
+    bool first_family = true;
+    for (int f = 0; base != nullptr && f < kTelemetryFamilies; f++) {
+      double elapsed = newest.ts - base->ts;
+      std::string fam;
+      bool first_route = true;
+      for (int r = 0; r < kRouteCount; r++) {
+        uint64_t delta[Hist::kBuckets + 1];
+        uint64_t n = 0;
+        for (int i = 0; i <= Hist::kBuckets; i++) {
+          delta[i] = newest.counts[f][r][i] - base->counts[f][r][i];
+          n += delta[i];
+        }
+        if (n == 0) continue;  // quiet routes stay out of the document
+        ::snprintf(buf, sizeof buf,
+                   "%s\"%s\":{\"count\":%llu,\"rate\":%.6g,"
+                   "\"p50\":%.6g,\"p99\":%.6g,\"sum\":%.6g}",
+                   first_route ? "" : ",", kRouteNames[r],
+                   (unsigned long long)n,
+                   elapsed > 0 ? static_cast<double>(n) / elapsed : 0.0,
+                   delta_quantile(delta, 0.5), delta_quantile(delta, 0.99),
+                   newest.sums[f][r] - base->sums[f][r]);
+        fam.append(buf);
+        first_route = false;
+      }
+      if (fam.empty()) continue;
+      ::snprintf(buf, sizeof buf, "%s\"%s\":{", first_family ? "" : ",",
+                 kTelemetryFamilyNames[f]);
+      out.append(buf);
+      out.append(fam);
+      out.append("}");
+      first_family = false;
+    }
+    out.append("}");
+  }
+  out.append("}}");
   return out;
 }
 

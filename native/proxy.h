@@ -218,6 +218,14 @@ class Proxy {
   // config, connection/pool/reactor state, restore-map and fill counts —
   // the native twin of the Python side's utils/statusz.snapshot()
   std::string statusz_json();
+  // time-series JSON for GET /debug/telemetry: sliding-window (30 s /
+  // 5 min) request rates and delta-bucket p50/p99 per histogram family
+  // and route, computed over a bounded ring of scrape snapshots. The
+  // ring is poll-driven: each call appends a snapshot (rate-limited by
+  // DEMODEL_TELEMETRY_MIN_GAP_MS), so the periodic pollers that exist anyway
+  // (tools/statusz.py --fleet --watch, the Python scrape-diff mirror)
+  // ARE the samplers — an unpolled proxy pays nothing.
+  std::string telemetry_json();
   int session_threads() const { return session_threads_; }
   int idle_timeout_sec() const { return idle_timeout_sec_; }
   bool reactor_enabled() const { return reactor_enabled_; }
@@ -323,6 +331,21 @@ class Proxy {
   bool reactor_enabled_ = false;  // resolved serve model (start())
   int max_conns_ = 0;             // resolved admission bound (start())
   std::atomic<int> conn_count_{0};  // live Session objects (all states)
+
+  // telemetry snapshot ring: periodic copies of every per-route hist's
+  // bucket vector + sum, diffed pairwise to answer "p99 over the last
+  // 30 s". Fixed families (latency / ttfb / upstream-ttfb) × routes ×
+  // buckets ≈ 4 KB per snapshot; the ring is capped by
+  // DEMODEL_TELEMETRY_RING (default 360, same as the Python plane).
+  static constexpr int kTelemetryFamilies = 3;
+  struct TelemetrySnap {
+    double ts = 0.0;    // steady seconds
+    double wall = 0.0;  // for the "time" field
+    uint64_t counts[kTelemetryFamilies][kRouteCount][Hist::kBuckets + 1];
+    double sums[kTelemetryFamilies][kRouteCount];
+  };
+  Mutex telemetry_mu_{kRankProxyTelemetry};
+  std::deque<TelemetrySnap> telemetry_ring_;
 };
 
 }  // namespace dm

@@ -897,6 +897,42 @@ static void test_statusz_endpoint(const std::string &root) {
   delete p;
 }
 
+static void test_telemetry_endpoint(const std::string &root) {
+  // GET /debug/telemetry answers the time-series view: each poll may
+  // append one snapshot to the bounded ring, and two polls with traffic
+  // between them expose windowed per-route count/rate/p50/p99
+  ::setenv("DEMODEL_TELEMETRY_MIN_GAP_MS", "10", 1);
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/telemetrystore";
+  cfg.verbose = false;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "telemetry proxy start");
+  int port = p->port();
+
+  std::string first = pool_get(port, "/debug/telemetry");
+  CHECK(first.find("200 OK") != std::string::npos, "telemetry 200");
+  CHECK(first.find("\"telemetry\":1") != std::string::npos,
+        "telemetry schema tag");
+  CHECK(first.find("\"windows\":{\"30\":{") != std::string::npos,
+        "telemetry windows");
+
+  for (int i = 0; i < 8; i++) pool_get(port, "/healthz");
+  ::usleep(20 * 1000);  // past the snapshot min-gap
+  std::string again = pool_get(port, "/debug/telemetry");
+  CHECK(again.find("\"snapshots\":2") != std::string::npos,
+        "telemetry ring grew");
+  CHECK(again.find("\"serve_request_seconds\":{") != std::string::npos,
+        "telemetry family present");
+  CHECK(again.find("\"healthz\":{\"count\":") != std::string::npos,
+        "healthz route in the window");
+  CHECK(again.find("\"p99\":") != std::string::npos, "windowed p99");
+  ::unsetenv("DEMODEL_TELEMETRY_MIN_GAP_MS");
+  p->stop();
+  delete p;
+}
+
 static void test_peer_window_fetch(const std::string &root) {
   // a proxy whose store holds one ~8 MB object; windows of it are fetched
   // back through /peer/object with the multi-stream ranged fan-out — the
@@ -981,6 +1017,7 @@ int main() {
   test_reactor_max_conns(root);
   test_reactor_stop_parked(root);
   test_statusz_endpoint(root);
+  test_telemetry_endpoint(root);
   test_peer_window_fetch(root);
   if (failures) {
     ::fprintf(stderr, "%d failures\n", failures);

@@ -27,3 +27,15 @@ def fine(peer, secs):
     name = "peer_breaker_open_total"
     HUB.inc(metrics.labeled(name, peer=peer) if peer else name)  # local literal
     HUB.inc(GOOD_NAME)                                   # module literal
+
+
+SERVE_WINDOWED = metrics.labeled("serve_seconds", route="object")
+
+
+def reads(tel, route):
+    tel.rate("pulls_total")                              # registered: ok
+    tel.window_quantile(SERVE_WINDOWED, 0.99)            # labeled base: ok
+    tel.family_rate("peer_retries_total")                # registered: ok
+    tel.rate("pulls_totl")                               # typo: no write
+    tel.window_quantile(f"serve_{route}_seconds", 0.5)   # non-literal read
+    HUB.rate("family_nothing_registers")                 # unregistered

@@ -115,6 +115,10 @@ GOLDEN = {
         ("metric-hygiene", 18),
         ("metric-hygiene", 19),
         ("metric-hygiene", 20),
+        # the read side: unregistered / non-literal telemetry lookups
+        ("metric-hygiene", 39),
+        ("metric-hygiene", 40),
+        ("metric-hygiene", 41),
     },
     # PR 5 receiver-typing upgrades: blocking I/O reached only through a
     # constructor-typed self-attribute / an executor-submit edge

@@ -23,6 +23,7 @@ from demodel_tpu.config import ProxyConfig
 from demodel_tpu.parallel.placement import (
     ChunkBoard,
     HashRing,
+    _bitmap_hex as bitmap_hex,
     bitmap_indices,
     bounded_assign,
     chunk_count,
@@ -321,6 +322,191 @@ def test_dead_host_chunks_reowned_not_repulled(tmp_path):
             "each dead-owned chunk re-owns exactly once (the successor)"
     finally:
         _teardown(scheds, servers, stores)
+        origin.stop()
+
+
+# ----------------------------------------------------------- board reaper
+
+
+def test_board_reap_unreap_and_stats():
+    board = ChunkBoard("p", "h")
+    board.add_file("fk", 3)
+    board.put("fk", 0, b"a" * 10)
+    board.put("fk", 1, b"b" * 10)
+    assert board.reap("fk", 0) == 10
+    assert board.reap("fk", 2) == 0  # never held: no-op
+    assert board.get("fk", 0) is None
+    assert board.done("fk", 0) and board.reaped("fk", 0)
+    assert not board.done("fk", 2)
+    st = board.stats()
+    assert st["chunks_have"] == 2, "progress keeps reaped chunks"
+    assert st["chunks_reaped"] == 1 and st["bytes_reaped"] == 10
+    assert st["bytes_held"] == 10
+    # the summary stops advertising a reaped chunk (we cannot serve it)
+    assert bitmap_indices(board.summary()["files"]["fk"]["have"], 3) == {1}
+    # a re-fetch un-reaps; unreap() alone clears the flag
+    board.put("fk", 0, b"c" * 10)
+    assert not board.reaped("fk", 0)
+    assert board.reap("fk", 1) == 10
+    board.unreap("fk", 1)
+    assert not board.done("fk", 1)
+
+
+def test_reaper_frees_swarm_boards_once_everyone_has_the_bytes(tmp_path):
+    """The ROADMAP swarm item b: once every live sibling advertises a
+    chunk AND the local delivery consumed past it, the reaper frees its
+    bytes — boards stop retaining the whole file set until close() —
+    with the reap visible on the scrape and the statusz swarm section."""
+    from demodel_tpu.sink.remote import PeerBlobReader, SwarmScheduler
+    from demodel_tpu.utils import statusz
+
+    origin, files = _seed_origin(tmp_path, n_files=1, mb=3, tag="reap")
+    servers, stores, participants = _swarm_hosts(
+        tmp_path, ("hA", "hB"), tag="reap")
+    scheds = []
+    try:
+        for hid in participants:
+            s = SwarmScheduler("treap", hid, participants)
+            for f in files:
+                s.add_file(f["key"], f["size"],
+                           PeerBlobReader(origin.url, f["key"], f["size"]))
+            scheds.append(s)
+        for s in scheds:
+            s.start()
+        for s in scheds:
+            s.fetch_all()
+            for f in files:
+                buf = bytearray(f["size"])
+                s.read_into(f["key"], memoryview(buf), 0)
+                assert hashlib.sha256(buf).hexdigest() == f["sha256"]
+        total = sum(chunk_count(f["size"], 1 << 20) for f in files)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and any(
+                s.board.stats()["bytes_held"] > 0 for s in scheds):
+            time.sleep(0.1)
+        for s in scheds:
+            st = s.board.stats()
+            assert st["bytes_held"] == 0, st
+            assert st["chunks_reaped"] == total
+            assert st["chunks_have"] == total, "progress must survive reap"
+        assert m.HUB.get("swarm_chunks_reaped_total") == 2 * total
+        assert m.HUB.get("swarm_bytes_reaped_total") == \
+            2 * sum(f["size"] for f in files)
+        doc = statusz.snapshot()
+        assert any(b["pull"] == "treap" and b["chunks_reaped"] == total
+                   for b in doc["swarm"])
+        # a late re-read when EVERY board reaped the chunk set: nobody
+        # can serve anybody, so the re-land must go straight to origin —
+        # bytes-exact, fast (never the 60 s owner-wait), and without
+        # condemning the healthy sibling as dead
+        t0 = time.monotonic()
+        f = files[0]
+        again = bytearray(f["size"])
+        scheds[0].read_into(f["key"], memoryview(again), 0)
+        assert hashlib.sha256(again).hexdigest() == f["sha256"]
+        assert time.monotonic() - t0 < 15, \
+            "reaped-everywhere re-read took the owner-wait path"
+        assert not scheds[0]._snapshot_dead(), \
+            "re-read must not condemn a healthy sibling"
+        assert m.HUB.get("swarm_chunks_unreaped_total") > 0
+    finally:
+        _teardown(scheds, servers, stores)
+        origin.stop()
+
+
+def test_reap_gates_on_gossiped_done_set_not_have_set():
+    """A sibling that reaped a chunk first stops ADVERTISING it (its
+    have-bitmap drops the chunk — it can no longer serve it), but its
+    done-bitmap keeps it: our reap gates on done, or the first host to
+    reap would block every later host from ever freeing the bytes. An
+    in-flight read's start offset also floors the reap, whatever the
+    completed high-water says."""
+    from demodel_tpu.sink.remote import SwarmScheduler
+
+    s = SwarmScheduler("tdone", "me", {"me": "http://127.0.0.1:9",
+                                       "sib": "http://127.0.0.1:9"})
+    try:
+        s.board.add_file("fk", 2)
+        with s._lock:
+            s._files["fk"] = (2 << 20, 2, None)
+            s._consumed_upto["fk"] = 2 << 20  # consumed everything
+        s.board.put("fk", 0, b"a" * (1 << 20))
+        s.board.put("fk", 1, b"b" * (1 << 20))
+        sib_done_have_reaped = {
+            "v": 5, "files": {"fk": {
+                "n": 2,
+                "have": bitmap_hex(set(), 2),        # reaped: serves none
+                "done": bitmap_hex({0, 1}, 2)}}}     # but landed both
+        s.merge_summary("sib", sib_done_have_reaped)
+        assert sorted(s._reap_candidates()) == [("fk", 0), ("fk", 1)]
+        # an in-flight read at offset 0 floors the reap below it
+        with s._lock:
+            s._active_reads["fk"] = [0]
+        assert s._reap_candidates() == []
+        with s._lock:
+            s._active_reads["fk"] = [1 << 20]
+        assert s._reap_candidates() == [("fk", 0)]
+        with s._lock:
+            s._active_reads["fk"] = []
+        # a sibling that landed NOTHING (done empty) blocks every reap
+        s.merge_summary("sib", {"v": 6, "files": {"fk": {
+            "n": 2, "have": bitmap_hex(set(), 2),
+            "done": bitmap_hex(set(), 2)}}})
+        assert s._reap_candidates() == []
+        # an old-style summary without "done" degrades to the have-set
+        s.merge_summary("sib", {"v": 7, "files": {"fk": {
+            "n": 2, "have": bitmap_hex({0, 1}, 2)}}})
+        assert sorted(s._reap_candidates()) == [("fk", 0), ("fk", 1)]
+    finally:
+        s.close()
+
+
+def test_reaped_chunk_rereads_correctly_and_reap_can_be_disabled(
+        tmp_path, monkeypatch):
+    """A solo board reaps on consumption alone; a late re-read of a
+    reaped chunk transparently re-lands it (counted, bytes-exact); and
+    DEMODEL_SWARM_REAP=0 restores retain-until-close()."""
+    from demodel_tpu.sink.remote import PeerBlobReader, SwarmScheduler
+
+    origin, files = _seed_origin(tmp_path, n_files=1, mb=2, tag="solo")
+    f = files[0]
+    try:
+        s = SwarmScheduler("tsolo", "solo", {"solo": "http://127.0.0.1:9"})
+        try:
+            s.add_file(f["key"], f["size"],
+                       PeerBlobReader(origin.url, f["key"], f["size"]))
+            s.start()
+            buf = bytearray(f["size"])
+            s.read_into(f["key"], memoryview(buf), 0)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline \
+                    and s.board.stats()["bytes_held"] > 0:
+                time.sleep(0.1)
+            assert s.board.stats()["bytes_held"] == 0
+            # the late re-read: ensure() un-reaps and re-fetches
+            again = bytearray(f["size"])
+            s.read_into(f["key"], memoryview(again), 0)
+            assert hashlib.sha256(again).hexdigest() == f["sha256"]
+            assert m.HUB.get("swarm_chunks_unreaped_total") > 0
+        finally:
+            s.close()
+
+        monkeypatch.setenv("DEMODEL_SWARM_REAP", "0")
+        s2 = SwarmScheduler("tsolo2", "solo", {"solo": "http://127.0.0.1:9"})
+        try:
+            s2.add_file(f["key"], f["size"],
+                        PeerBlobReader(origin.url, f["key"], f["size"]))
+            s2.start()
+            buf = bytearray(f["size"])
+            s2.read_into(f["key"], memoryview(buf), 0)
+            time.sleep(1.5)  # past several would-be reap ticks
+            st = s2.board.stats()
+            assert st["chunks_reaped"] == 0
+            assert st["bytes_held"] == f["size"], \
+                "DEMODEL_SWARM_REAP=0 must retain until close()"
+        finally:
+            s2.close()
+    finally:
         origin.stop()
 
 

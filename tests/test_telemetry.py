@@ -1,0 +1,428 @@
+"""The telemetry time-series plane: windowed quantiles over snapshot
+rings, scrape-diffing the native proxy, and the ``/debug/telemetry``
+endpoints on both planes.
+
+The delta-bucket math is the load-bearing piece: ``window_quantile`` must
+answer from ONLY the samples observed inside the window (the delta of the
+cumulative buckets between two ring snapshots), never the lifetime
+distribution — a week-old process's history must not drown the last 30
+seconds. Covered: delta-vs-lifetime under concurrent observe, ring
+eviction at the cap, empty-window and counter-reset (process restart)
+behavior, and a native-scrape diff round-trip.
+"""
+
+from __future__ import annotations
+
+import http.client
+import io
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from demodel_tpu.utils import metrics as m
+from demodel_tpu.utils import statusz, trace
+from demodel_tpu.utils.faults import PeerHealth
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    trace.reset()
+    m.HUB.reset()
+    PeerHealth.reset_shared()
+    yield
+    trace.reset()
+    m.HUB.reset()
+    PeerHealth.reset_shared()
+
+
+def _clocked_telemetry(cap=8, min_gap=0.0):
+    clock = {"t": 0.0}
+    tel = m.Telemetry(m._hub_source(m.HUB), cap=cap, min_gap_s=min_gap,
+                      clock=lambda: clock["t"])
+    return tel, clock
+
+
+# ------------------------------------------------------ delta-bucket math
+
+
+def test_window_quantile_is_delta_not_lifetime():
+    """1000 historic fast samples, 10 recent slow ones: the lifetime p50
+    stays fast, the window p50 must report the recent slowness."""
+    tel, clock = _clocked_telemetry()
+    for _ in range(1000):
+        m.HUB.observe("stage", 0.003)   # bucket le=0.0032
+    tel.sample()
+    clock["t"] = 30.0
+    for _ in range(10):
+        m.HUB.observe("stage", 0.05)    # bucket le=0.0512
+    tel.sample()
+    assert m.HUB.get_histogram("stage").quantile(0.5) == \
+        pytest.approx(0.0032)
+    assert tel.window_quantile("stage", 0.5, 30) == pytest.approx(0.0512)
+    assert tel.window_quantile("stage", 0.99, 30) == pytest.approx(0.0512)
+    d = tel.window_delta("stage", 30)
+    assert d["count"] == 10 and d["elapsed_s"] == pytest.approx(30.0)
+
+
+def test_windowed_quantiles_under_concurrent_observe():
+    """Writers hammering the hub while a sampler ticks: every window
+    delta must stay non-negative and internally consistent (the hub
+    snapshot is taken under its lock, so a ring entry is a coherent
+    point-in-time copy, never a torn read)."""
+    tel, clock = _clocked_telemetry(cap=64)
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            m.HUB.observe("conc", 0.004)
+            m.HUB.inc("conc_total")
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(30):
+            clock["t"] = float(i)
+            tel.sample()
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    for w in (5, 30):
+        d = tel.window_delta("conc", w)
+        assert d is not None
+        assert all(c >= 0 for c in d["counts"])
+        assert d["count"] == sum(d["counts"])
+        assert tel.rate("conc_total", w) >= 0
+    assert tel.window_quantile("conc", 0.99, 30) == pytest.approx(0.0064)
+
+
+def test_ring_eviction_at_cap():
+    tel, clock = _clocked_telemetry(cap=4)
+    for i in range(10):
+        clock["t"] = float(i)
+        m.HUB.inc("evict_total")
+        tel.sample()
+    assert len(tel) == 4
+    assert tel.samples_taken == 10
+    # the window can only reach back to the oldest SURVIVING snapshot:
+    # 4 ticks × 1 counter-inc each → a 100s window sees 3 increments
+    assert tel.rate("evict_total", 100) == pytest.approx(3 / 3.0)
+
+
+def test_empty_window_behavior():
+    # high min-gap: freshen() may take the FIRST snapshot (empty ring)
+    # but never piles extras onto the injected clock
+    tel, clock = _clocked_telemetry(min_gap=999.0)
+    # no window at all: one snapshot max, nothing to diff
+    assert tel.rate("nothing_total", 30) == 0.0
+    assert tel.window_quantile("nothing", 0.99, 30) == 0.0
+    assert tel.window_delta("nothing", 30) is None
+    assert tel.series("nothing") == []
+    # two snapshots with NO new samples between them: empty window, 0.0
+    m.HUB.observe("quiet", 0.01)
+    clock["t"] = 10.0
+    tel.sample()
+    clock["t"] = 20.0
+    tel.sample()
+    d = tel.window_delta("quiet", 10)
+    assert d["count"] == 0 and tel.window_quantile("quiet", 0.99, 10) == 0.0
+    # a window reaching back BEFORE the family existed counts everything
+    # (an absent baseline is an empty baseline)
+    assert tel.window_quantile("quiet", 0.99, 30) == pytest.approx(0.0128)
+
+
+def test_counter_reset_is_rate_from_zero():
+    """A restarted process re-registers counters near zero: the window
+    must not report a huge negative (or wrapped) rate — the Prometheus
+    convention is rate-from-zero."""
+    feed = {"counters": {"x_total": 1000.0}, "gauges": {}, "hists": {}}
+    clock = {"t": 0.0}
+    tel = m.Telemetry(lambda: {k: dict(v) for k, v in feed.items()},
+                      cap=8, min_gap_s=0.0, clock=lambda: clock["t"])
+    tel.sample()
+    clock["t"] = 10.0
+    feed["counters"] = {"x_total": 40.0}  # restarted: 1000 → 40
+    tel.sample()
+    assert tel.rate("x_total", 10) == pytest.approx(4.0)
+
+
+def test_histogram_reset_zeroes_the_baseline():
+    h1 = {"le": list(m.BUCKET_BOUNDS),
+          "counts": [50] + [0] * len(m.BUCKET_BOUNDS), "sum": 1.0}
+    h2 = {"le": list(m.BUCKET_BOUNDS),
+          "counts": [3] + [0] * len(m.BUCKET_BOUNDS), "sum": 0.01}
+    feed = {"counters": {}, "gauges": {}, "hists": {"h": h1}}
+    clock = {"t": 0.0}
+    tel = m.Telemetry(lambda: json.loads(json.dumps(feed)), cap=8,
+                      min_gap_s=0.0, clock=lambda: clock["t"])
+    tel.sample()
+    clock["t"] = 30.0
+    feed["hists"]["h"] = h2
+    tel.sample()
+    d = tel.window_delta("h", 30)
+    assert d["count"] == 3, "a shrunken bucket means reset → zero baseline"
+
+
+def test_failing_source_degrades_not_crashes():
+    calls = {"n": 0}
+
+    def source():
+        calls["n"] += 1
+        raise RuntimeError("proxy stopped")
+
+    tel = m.Telemetry(source, cap=8, min_gap_s=0.0)
+    assert tel.sample() is False
+    assert tel.samples_failed == 1 and len(tel) == 0
+    assert tel.rate("x", 30) == 0.0  # freshen retries, still no crash
+
+
+# ------------------------------------------------- native scrape diffing
+
+
+class _FakeProxy:
+    """ProxyServer-shaped: .metrics() returns the native JSON shape."""
+
+    def __init__(self):
+        self._h = object()  # "running" marker native_source checks
+        self.requests = 0
+        self.counts = [0] * (m.Histogram().bounds.__len__() + 1)
+        self.sum = 0.0
+
+    def observe(self, sec):
+        from bisect import bisect_left
+
+        self.counts[bisect_left(m.BUCKET_BOUNDS, sec)] += 1
+        self.sum += sec
+        self.requests += 1
+
+    def metrics(self):
+        return {
+            "requests": self.requests,
+            "sessions_active": 2,
+            "hist": {
+                "serve_request_seconds": {
+                    "le": list(m.BUCKET_BOUNDS),
+                    "routes": {
+                        "peer_object": {"counts": list(self.counts),
+                                        "sum": self.sum,
+                                        "count": sum(self.counts)},
+                    },
+                },
+            },
+        }
+
+
+def test_native_scrape_diff_round_trip():
+    """The Python-side mirror of the native plane: successive scrapes
+    diffed into the same windowed views the hub gets — counter rates,
+    gauge last-value, and delta-bucket quantiles per route."""
+    proxy = _FakeProxy()
+    clock = {"t": 0.0}
+    tel = m.Telemetry(m.native_source(proxy), cap=16, min_gap_s=0.0,
+                      clock=lambda: clock["t"])
+    proxy.observe(0.003)
+    proxy.requests += 10
+    tel.sample()
+    clock["t"] = 30.0
+    for _ in range(5):
+        proxy.observe(0.1)
+    proxy.requests += 30
+    tel.sample()
+    name = m.labeled("serve_request_seconds", route="peer_object")
+    assert tel.window_quantile(name, 0.99, 30) == pytest.approx(0.1024)
+    assert tel.rate("requests", 30) == pytest.approx(35 / 30.0)
+    d = tel.window_delta(name, 30)
+    assert d["count"] == 5 and d["sum"] == pytest.approx(0.5)
+    # gauges pass through as last-value
+    assert tel.summary()["gauges"]["sessions_active"] == 2
+    # a stopped proxy (handle freed) degrades to skipped samples
+    proxy._h = None
+    assert tel.sample() is False
+    assert m.native_telemetry(proxy) is m.native_telemetry(proxy)
+
+
+# --------------------------------------------------- /debug/telemetry
+
+
+def _get_json(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path, headers={"Connection": "close"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def test_restore_server_telemetry_endpoint(tmp_path):
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+    from demodel_tpu.store import Store
+
+    store = Store(tmp_path / "s")
+    try:
+        with RestoreServer(RestoreRegistry(store),
+                           host="127.0.0.1") as srv:
+            with trace.span("window-read"):
+                pass
+            m.HUB.telemetry().sample()
+            time.sleep(0.3)
+            with trace.span("window-read"):
+                time.sleep(0.001)
+            status, doc = _get_json(srv.port, "/debug/telemetry")
+            assert status == 200
+            assert doc["telemetry"] == 1 and doc["server"] == "restore"
+            assert doc["windows"]["windows_s"] == [30, 300]
+            fam = doc["windows"]["hist"][
+                'stage_duration_seconds{span="window-read"}']
+            assert fam["30"]["count"] >= 1 and fam["30"]["p99"] > 0
+            # the statusz document carries the compact telemetry slice
+            # and the effective-config section, and both pass the gate
+            status, sdoc = _get_json(srv.port, "/debug/statusz")
+            assert sdoc["telemetry"]["windows_s"] == [30, 300]
+            assert sdoc["config"]["DEMODEL_PEER_STREAMS"]["source"] in (
+                "env", "default")
+            for url_path in ("/debug/statusz", "/debug/telemetry"):
+                proc = subprocess.run(
+                    [sys.executable, "tools/statusz.py",
+                     f"http://127.0.0.1:{srv.port}{url_path}",
+                     "--validate"],
+                    cwd=REPO, capture_output=True, text=True, timeout=60)
+                assert proc.returncode == 0, (url_path, proc.stderr)
+    finally:
+        store.close()
+
+
+def test_native_proxy_telemetry_endpoint(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEMODEL_TELEMETRY_MIN_GAP_MS", "50")
+    from demodel_tpu.config import ProxyConfig
+    from demodel_tpu.proxy import ProxyServer
+
+    cfg = ProxyConfig(host="127.0.0.1", port=0, mitm_hosts=[],
+                      no_mitm=True, cache_dir=tmp_path / "c",
+                      data_dir=tmp_path / "d")
+    node = ProxyServer(cfg, verbose=False).start()
+    try:
+        status, first = _get_json(node.port, "/debug/telemetry")
+        assert status == 200 and first["telemetry"] == 1
+        assert first["server"] == "demodel-native-proxy"
+        assert set(first["windows"]) == {"30", "300"}
+        for _ in range(5):
+            _get_json(node.port, "/healthz")
+        time.sleep(0.1)
+        _status, doc = _get_json(node.port, "/debug/telemetry")
+        assert doc["snapshots"] >= 2
+        served = doc["windows"]["30"]["serve_request_seconds"]
+        assert served["healthz"]["count"] >= 5
+        assert served["healthz"]["p99"] > 0
+        assert served["healthz"]["rate"] > 0
+        # schema gate accepts the native document too
+        proc = subprocess.run(
+            [sys.executable, "tools/statusz.py",
+             f"http://127.0.0.1:{node.port}/debug/telemetry", "--validate"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+
+        # the Python mirror over the SAME proxy: both sides serve a
+        # windowed serve-leg p99 (the acceptance criterion's two planes)
+        tel = m.native_telemetry(node)
+        tel.sample()
+        for _ in range(5):
+            _get_json(node.port, "/healthz")
+        time.sleep(0.05)
+        tel.sample()
+        name = m.labeled("serve_request_seconds", route="healthz")
+        assert tel.window_quantile(name, 0.99, 30) > 0
+    finally:
+        node.stop()
+
+
+def test_statusz_config_reports_env_and_tuner_sources(monkeypatch):
+    monkeypatch.setenv("DEMODEL_SWARM_CHUNK_MB", "4")
+    cfg = statusz.effective_config()
+    assert cfg["DEMODEL_SWARM_CHUNK_MB"] == {"value": 4, "source": "env"}
+    assert cfg["DEMODEL_RETRY_MAX"]["source"] == "default"
+    from demodel_tpu.sink.tuner import PullTuner
+
+    tuner = PullTuner(prefetch_depth=2, tick_s=5, window_s=5)
+    tuner.start()
+    try:
+        cfg = statusz.effective_config()
+        assert cfg["DEMODEL_PEER_STREAMS"]["source"] == "tuner"
+        assert cfg["DEMODEL_PEER_STREAMS"]["value"] == tuner.streams
+        assert cfg["DEMODEL_PULL_WINDOW_MB"] == {
+            "value": tuner.window_mb, "source": "tuner"}
+    finally:
+        tuner.stop()
+    assert statusz.effective_config()["DEMODEL_PEER_STREAMS"]["source"] \
+        != "tuner"
+
+
+def test_statusz_config_scrape_stays_dep_light():
+    """The effective-config section must resolve every knob default
+    WITHOUT importing jax/numpy or the sink/parallel planes — importing
+    parallel.peer, parallel.placement, or sink.tuner runs their
+    packages' __init__ and drags jax into a dep-light scrape (the knob
+    resolvers live in utils.env for exactly this reason)."""
+    code = (
+        "import sys\n"
+        "from demodel_tpu.utils import statusz\n"
+        "doc = statusz.snapshot()\n"
+        "assert doc['config']['DEMODEL_TUNER']['value'] is True\n"
+        "assert doc['config']['DEMODEL_SWARM_FILL_TIMEOUT']['value'] == 60\n"
+        "for mod in ('jax', 'numpy', 'demodel_tpu.sink.tuner',\n"
+        "            'demodel_tpu.parallel.peer',\n"
+        "            'demodel_tpu.parallel.placement'):\n"
+        "    assert mod not in sys.modules, mod + ' leaked'\n")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_fleet_watch_emits_jsonl_series(tmp_path):
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+    from demodel_tpu.store import Store
+    from tools.statusz import watch_fleet
+
+    store = Store(tmp_path / "s")
+    try:
+        with RestoreServer(RestoreRegistry(store),
+                           host="127.0.0.1") as srv:
+            with trace.span("window-read"):
+                pass
+            out = io.StringIO()
+            rc = watch_fleet(
+                [f"127.0.0.1:{srv.port}", "127.0.0.1:9"],
+                interval_s=0.3, samples=2, out=out)
+            assert rc == 0
+            lines = [json.loads(x) for x in
+                     out.getvalue().strip().splitlines()]
+            assert len(lines) == 2
+            for tick in lines:
+                assert tick["metric"] == "telemetry_fleet"
+                (host,) = tick["hosts"]
+                assert host["server"] == "restore"
+                (down,) = tick["unreachable"]
+                assert down["host"] == "127.0.0.1:9"
+            # the second tick has a window (the watch itself drove the
+            # sampling cadence)
+            p99s = lines[1]["hosts"][0]["p99_30s"]
+            assert 'stage_duration_seconds{span="window-read"}' in p99s
+    finally:
+        store.close()
+
+
+def test_hub_reset_clears_the_ring():
+    m.HUB.inc("reset_total")
+    m.HUB.telemetry().sample()
+    assert len(m.HUB.telemetry()) == 1
+    m.HUB.reset()
+    assert len(m.HUB.telemetry()) == 0

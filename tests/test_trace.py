@@ -522,6 +522,11 @@ def test_traced_chaos_pull_end_to_end(tmp_path, _fast_wire, monkeypatch):
     critical-path report from ``tools/trace_report.py``."""
     jsonl = tmp_path / "pull.jsonl"
     monkeypatch.setenv("DEMODEL_TRACE", str(jsonl))
+    # this test pins the TRACE SHAPE of a faulted pull; the adaptive
+    # tuner (its own root span, sub-window splitting, a tick thread
+    # competing for this 1-CPU box) is pinned off — its in-pull
+    # integration is covered by test_tuner.py
+    monkeypatch.setenv("DEMODEL_TUNER", "0")
     trace.reset()
 
     from demodel_tpu.sink.remote import pull_manifest_to_hbm
@@ -559,10 +564,18 @@ def test_traced_chaos_pull_end_to_end(tmp_path, _fast_wire, monkeypatch):
     assert any(n == "failover" for n, _ in events), events
     assert any(n == "fault" and a.get("kind") == "reset-at-byte"
                for n, a in events), events
-    # the faulted window resumed at the received offset on the OTHER peer
-    failover = next(a for n, a in events if n == "failover")
-    assert failover["resume_at"] > 0
-    assert failover["from_peer"] != failover["to_peer"]
+    # the faulted window failed over to the OTHER peer, resuming at the
+    # received offset. The linger-0 RST discards whatever the client had
+    # not yet drained from the kernel buffer, so a slow-scheduled reader
+    # legitimately resumes at 0 — exact positive-offset resume is pinned
+    # by the Range-log tests in test_fault_injection; here the contract
+    # is the trace shape, and the retry event must agree with the
+    # failover on where the resume happened
+    failovers = [a for n, a in events if n == "failover"]
+    assert failovers, events
+    assert all(a["from_peer"] != a["to_peer"] for a in failovers)
+    retry_offsets = {a["resume_at"] for n, a in events if n == "retry"}
+    assert any(a["resume_at"] in retry_offsets for a in failovers), events
 
     # (c+d) the report tool: one JSON line + a Perfetto-loadable file
     chrome = tmp_path / "pull.json"

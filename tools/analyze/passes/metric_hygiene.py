@@ -15,8 +15,22 @@ first argument must itself resolve). Everything else — f-strings,
 ``%``/``+``/``.format`` composition, names bound to expressions — is a
 finding.
 
+The READ side has the inverse hazard: the telemetry plane's windowed
+views (``rate`` / ``window_quantile`` / ``family_rate`` / ``series`` /
+``window_delta``) look families up by name, and a typo'd name doesn't
+raise — it silently returns an empty window, which a consumer like the
+adaptive pull tuner would read as "all quiet" forever. So read-site
+names must (a) resolve to literals exactly like write-site names, and
+(b) name a family some ``inc``/``set_gauge``/``observe`` write in the
+analyzed tree actually registers (checked in :meth:`finalize`, once the
+whole run's write set is known).
+
 Scope: files under ``demodel_tpu/`` plus any file carrying an explicit
 ``# demodel: metrics-plane`` pragma (how the golden fixture opts in).
+Write-site names are COLLECTED from every module in the run (benches and
+tests register families too); the plane itself
+(``demodel_tpu/utils/metrics.py``) is exempt from the read check — its
+methods pass caller-supplied names through parameters.
 """
 
 from __future__ import annotations
@@ -35,6 +49,15 @@ from tools.analyze.core import (
 )
 
 _METHODS = {"inc", "set_gauge", "observe"}
+#: windowed-view lookups whose name arg silently yields an empty window
+#: when it names a family nothing registers
+_READS = {"rate", "window_quantile", "family_rate", "series",
+          "window_delta"}
+#: receivers a read call counts under: the hub itself or a telemetry
+#: ring (``tel`` is the tree's idiomatic local for one)
+_READ_RECEIVERS = {"HUB", "hub", "tel", "telemetry"}
+#: the plane itself — its forwarding methods take names as parameters
+_PLANE = "demodel_tpu/utils/metrics.py"
 _PRAGMA = "# demodel: metrics-plane"
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
@@ -76,12 +99,16 @@ class _Resolver:
         self.fn = enclosing_function(call)
         self.ctx = ctx
         self.seen: set[str] = set()
+        #: every base family literal the expression resolves through —
+        #: only meaningful when :meth:`resolve` returned None (fine)
+        self.names: set[str] = set()
 
     def resolve(self, expr: ast.expr) -> str | None:
         if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
             if not _NAME_RE.match(expr.value):
                 return (f"metric name {expr.value!r} is not snake_case — "
                         "labels belong in labeled(), not the name")
+            self.names.add(expr.value)
             return None
         if isinstance(expr, ast.Call) and _is_labeled_call(expr):
             if not expr.args:
@@ -114,31 +141,79 @@ class _Resolver:
                 "names must be literal snake_case, variance via labeled()")
 
 
+def _is_read_receiver(value: ast.expr) -> bool:
+    """The hub, a telemetry local, or a ``...telemetry()`` call chain."""
+    recv = dotted(value)
+    if recv is not None:
+        return recv.rsplit(".", 1)[-1] in _READ_RECEIVERS
+    if isinstance(value, ast.Call):
+        f = dotted(value.func)
+        return f is not None and f.rsplit(".", 1)[-1] == "telemetry"
+    return False
+
+
 @register
 class MetricHygienePass(Pass):
     id = "metric-hygiene"
     description = (
         "metric names passed to Hub.inc/set_gauge/observe must be literal "
         "snake_case (labels only via metrics.labeled) — dynamic names are "
-        "unbounded scrape cardinality"
+        "unbounded scrape cardinality; telemetry reads (rate/"
+        "window_quantile/...) must name a family some write registers — "
+        "a typo'd read silently returns an empty window"
     )
 
+    def __init__(self) -> None:
+        super().__init__()
+        self._written: set[str] = set()
+        self._reads: list[tuple[str, int, str]] = []
+
     def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
-        if not (ctx.rel.startswith("demodel_tpu/")
-                or _PRAGMA in ctx.source):
-            return
+        in_scope = (ctx.rel.startswith("demodel_tpu/")
+                    or _PRAGMA in ctx.source)
         for node in ast.walk(ctx.tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _METHODS
                     and node.args):
                 continue
-            recv = dotted(node.func.value)
-            if recv is None:
-                continue
-            last = recv.rsplit(".", 1)[-1]
-            if last not in ("HUB", "hub"):
-                continue
-            reason = _Resolver(node, ctx).resolve(node.args[0])
-            if reason:
-                yield Finding(ctx.rel, node.lineno, self.id, reason)
+            attr = node.func.attr
+            if attr in _METHODS:
+                recv = dotted(node.func.value)
+                if recv is None:
+                    continue
+                last = recv.rsplit(".", 1)[-1]
+                if last not in ("HUB", "hub"):
+                    continue
+                resolver = _Resolver(node, ctx)
+                reason = resolver.resolve(node.args[0])
+                if reason:
+                    if in_scope:
+                        yield Finding(ctx.rel, node.lineno, self.id, reason)
+                else:
+                    # write-site families register regardless of scope:
+                    # benches/tests mint real families too, and the read
+                    # check below must not flag them as typos
+                    self._written |= resolver.names
+            elif attr in _READS and in_scope and ctx.rel != _PLANE \
+                    and _is_read_receiver(node.func.value):
+                resolver = _Resolver(node, ctx)
+                reason = resolver.resolve(node.args[0])
+                if reason:
+                    yield Finding(ctx.rel, node.lineno, self.id,
+                                  f"telemetry read: {reason}")
+                else:
+                    for name in resolver.names:
+                        self._reads.append((ctx.rel, node.lineno, name))
+
+    def finalize(self) -> Iterator[Finding]:
+        if not self._written:
+            # a run with zero write sites is a fragment without the
+            # metrics plane — nothing meaningful to validate against
+            return
+        for rel, line, name in self._reads:
+            if name not in self._written:
+                yield Finding(
+                    rel, line, self.id,
+                    f"telemetry read of family {name!r} that no "
+                    "Hub.inc/set_gauge/observe in this tree registers — "
+                    "the window is silently empty (typo'd name?)")

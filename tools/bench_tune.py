@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Adaptive-pull-tuning benchmark driver — prints ONE JSON line (same
+contract as ``bench.py`` / ``bench_serve.py`` / ``bench_swarm.py``).
+
+Scenario: the closed loop's proof. A warm origin node sits behind a
+per-connection rate-limited, fault-injected shim (``ChaosPeer``:
+throttle + a couple of mid-pull stalls — the constrained flaky link the
+tuner exists for), and two leg families pull the same file set through
+it with the SAME windowed-fetch driver:
+
+  fixed     a sweep of hand-picked (streams, window) configs, tuner off
+            — the envelope the adaptive leg is judged against;
+  adaptive  knobs start at the env defaults and a live
+            :class:`~demodel_tpu.sink.tuner.PullTuner` moves them from
+            the telemetry plane's sliding-window signals, over several
+            passes so the convergence (not just the cold ramp) shows.
+
+EVERY pass — fixed or adaptive — runs against a FRESH shim with the
+identical fault plan and throttle, so both leg families face the same
+faults per pass and the comparison is about the knobs, nothing else
+(the tuner itself survives across the adaptive passes: convergence is
+the point). Because the shim throttles PER CONNECTION, per-peer stream
+concurrency is real aggregate bandwidth — the knob the controller must
+discover (the native fan-out clamps to one stream per 4 MB of window,
+so the file size bounds the reachable concurrency).
+
+Reported: per-config fixed throughputs, per-pass adaptive throughputs,
+the converged adaptive rate (median of the last 3 passes), tuner
+decision count + final knobs + span-event visibility, and ``tuner_ok``:
+converged ≥ 0.9× the best fixed point and overall ≥ 1.2× the worst
+(smoke: 0.7× / 0.9× — smoke sizes leave little stream headroom, so it
+gates sanity + observability, not the convergence claim).
+
+Env knobs: DEMODEL_TUNE_BENCH_FILES (2), DEMODEL_TUNE_BENCH_FILE_MB
+(16; smoke 8), DEMODEL_TUNE_BENCH_THROTTLE_MBPS per connection (6;
+smoke 10), DEMODEL_TUNE_BENCH_PASSES (6; smoke 4). ``--smoke`` (or
+DEMODEL_TUNE_SMOKE=1) shrinks everything for CI.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+SMOKE = ("--smoke" in sys.argv
+         or os.environ.get("DEMODEL_TUNE_SMOKE", "").strip() == "1")
+
+
+def _env_i(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+N_FILES = _env_i("DEMODEL_TUNE_BENCH_FILES", 2)
+FILE_MB = _env_i("DEMODEL_TUNE_BENCH_FILE_MB", 8 if SMOKE else 16)
+THROTTLE = _env_i("DEMODEL_TUNE_BENCH_THROTTLE_MBPS", 10 if SMOKE else 6)
+PASSES = _env_i("DEMODEL_TUNE_BENCH_PASSES", 4 if SMOKE else 6)
+
+#: the hand-picked sweep the adaptive leg is judged against: a floor
+#: (single stream, small windows), the untouched env defaults, and an
+#: aggressive point (max streams, big windows)
+FIXED_CONFIGS = (
+    ("floor", 1, 4 << 20),
+    ("default", None, None),   # resolved from env at run time
+    ("aggressive", 8, 64 << 20),
+)
+
+
+def _origin_node(tmp: Path):
+    from demodel_tpu.config import ProxyConfig
+    from demodel_tpu.proxy import ProxyServer
+    from demodel_tpu.store import Store
+
+    cfg = ProxyConfig(
+        host="127.0.0.1", port=0, mitm_hosts=[], no_mitm=True,
+        cache_dir=tmp / "origin-cache", data_dir=tmp / "origin-data")
+    store = Store(cfg.cache_dir / "proxy")
+    files = []
+    try:
+        for i in range(N_FILES):
+            body = os.urandom(1 << 20) * FILE_MB
+            key = f"tunebench{i:04d}"
+            store.put(key, body,
+                      {"content-type": "application/octet-stream"})
+            files.append({"key": key, "size": len(body),
+                          "sha256": hashlib.sha256(body).hexdigest()})
+    finally:
+        store.close()
+    node = ProxyServer(cfg, verbose=False)
+    node.start()
+    return node, files
+
+
+def _plan():
+    from chaoshttp import FaultPlan, FaultSpec
+
+    # a couple of mid-body resets per leg: enough that the wire is
+    # genuinely faulty (window resume + retry accounting runs), mild
+    # enough that the throughput comparison stays about the knobs
+    return FaultPlan(FaultSpec(kind="stall", path="/peer/object",
+                               times=2, stall_secs=0.3))
+
+
+def _fetch_pass(url: str, files, knobs) -> tuple[float, float, bool]:
+    """One pass over the whole file set with the windowed-fetch driver
+    (the same loop the pipelined pull's fetch stage uses). Returns
+    (secs, MB/s, bytes_exact) — digests computed OUTSIDE the clock."""
+    from demodel_tpu.sink.remote import PeerBlobReader
+    from demodel_tpu.sink.tuner import fetch_windows
+
+    bufs = []
+    t0 = time.monotonic()
+    for f in files:
+        reader = PeerBlobReader(url, f["key"], f["size"], streams=1)
+        buf = bytearray(f["size"])
+        fetch_windows(reader, f["key"], buf, 0, knobs)
+        bufs.append(buf)
+    secs = time.monotonic() - t0
+    total = sum(f["size"] for f in files)
+    ok = all(hashlib.sha256(b).hexdigest() == f["sha256"]
+             for b, f in zip(bufs, files))
+    return secs, total / secs / (1 << 20), ok
+
+
+def _reset_state():
+    from demodel_tpu.utils import metrics as m
+    from demodel_tpu.utils.faults import PeerHealth
+
+    m.HUB.reset()
+    PeerHealth.reset_shared()
+
+
+def main() -> int:  # noqa: C901
+    os.environ.setdefault("DEMODEL_RETRY_BASE_MS", "20")
+    os.environ.setdefault("DEMODEL_TUNER_TICK_MS", "200")
+    os.environ.setdefault("DEMODEL_TUNER_WINDOW_S", "3")
+    os.environ.setdefault("DEMODEL_TELEMETRY_MIN_GAP_MS", "100")
+    sys.path.insert(0, str(REPO / "tests"))
+    from chaoshttp import ChaosPeer
+
+    from demodel_tpu.parallel import peer as peer_mod
+    from demodel_tpu.sink.tuner import PullTuner
+    from demodel_tpu.utils import metrics as m
+    from demodel_tpu.utils import trace
+
+    tmp = Path(tempfile.mkdtemp(prefix="tunebench-"))
+    node, files = _origin_node(tmp)
+    total_mb = sum(f["size"] for f in files) / (1 << 20)
+    throttle_bps = THROTTLE << 20
+    out: dict = {
+        "metric": "tune_bench", "smoke": SMOKE, "files": N_FILES,
+        "total_mb": round(total_mb, 1),
+        "throttle_mbps_per_conn": THROTTLE, "passes": PASSES,
+    }
+    try:
+        # ---- the fixed sweep (tuner off: knobs pinned per config)
+        fixed: dict = {}
+        for name, streams, window in FIXED_CONFIGS:
+            _reset_state()
+            if streams is None:
+                from demodel_tpu.utils.env import default_pull_window_mb
+
+                streams = peer_mod._peer_streams()  # noqa: SLF001
+                window = default_pull_window_mb() << 20
+            knobs = SimpleNamespace(streams=streams, window_bytes=window)
+            with ChaosPeer(node.url, _plan(),
+                           throttle_bps=throttle_bps) as shim:
+                secs, mbps, ok = _fetch_pass(shim.url, files, knobs)
+            fixed[name] = {"streams": streams,
+                           "window_mb": window >> 20,
+                           "secs": round(secs, 3),
+                           "mbps": round(mbps, 2), "bytes_exact": ok}
+        out["fixed"] = fixed
+        best = max(v["mbps"] for v in fixed.values())
+        worst = min(v["mbps"] for v in fixed.values())
+        out["best_fixed_mbps"] = best
+        out["worst_fixed_mbps"] = worst
+
+        # ---- the adaptive leg: knobs start at env defaults, the tuner
+        # moves them from the live windowed signals over several passes.
+        # A FRESH shim per pass replays the exact fault plan the fixed
+        # legs faced — the comparison is symmetric, and the overall rate
+        # sums pass transfer times only (shim setup stays off the clock,
+        # as it does for the fixed legs).
+        _reset_state()
+        pass_mbps: list[float] = []
+        pass_secs: list[float] = []
+        adaptive_exact = True
+        tuner = PullTuner(prefetch_depth=0).start()
+        try:
+            for _ in range(PASSES):
+                with ChaosPeer(node.url, _plan(),
+                               throttle_bps=throttle_bps) as shim:
+                    secs, mbps, ok = _fetch_pass(shim.url, files, tuner)
+                adaptive_exact = adaptive_exact and ok
+                pass_mbps.append(round(mbps, 2))
+                pass_secs.append(secs)
+        finally:
+            tuner.stop()
+        overall = total_mb * PASSES / sum(pass_secs)
+        converged = statistics.median(pass_mbps[-3:])
+        out["adaptive"] = {
+            "pass_mbps": pass_mbps,
+            "overall_mbps": round(overall, 2),
+            "converged_mbps": round(converged, 2),
+            "bytes_exact": adaptive_exact,
+            "decisions": tuner.decisions,
+            "final_knobs": tuner.snapshot(),
+        }
+        # the tuner's own observability: decisions as span events in the
+        # always-on flight recorder + tuner_* gauges on the scrape
+        tuner_spans = [r for r in trace.recorder().snapshot()
+                       if r["name"] == "tuner"]
+        tune_events = [e for r in tuner_spans
+                       for e in r.get("events", ())
+                       if e["name"] == "tune"]
+        out["adaptive"]["span_events"] = len(tune_events)
+        out["adaptive"]["gauges"] = {
+            k: v for k, v in m.HUB.gauges().items()
+            if k.startswith("tuner_")}
+        retry_total = sum(v for k, v in m.HUB.snapshot().items()
+                          if k.startswith("peer_retries_total"))
+        out["adaptive"]["retries"] = int(retry_total)
+    finally:
+        node.stop()
+
+    conv_bound, worst_bound = (0.7, 0.9) if SMOKE else (0.9, 1.2)
+    out["bounds"] = {"converged_vs_best": conv_bound,
+                     "overall_vs_worst": worst_bound}
+    out["tuner_ok"] = bool(
+        all(v["bytes_exact"] for v in fixed.values())
+        and adaptive_exact
+        and out["adaptive"]["decisions"] > 0
+        and out["adaptive"]["span_events"] > 0
+        and "tuner_streams" in out["adaptive"]["gauges"]
+        and converged >= conv_bound * best
+        and overall >= worst_bound * worst)
+    print(json.dumps(out))
+    return 0 if out["tuner_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,12 +22,20 @@ every host's statusz — per-host open breakers, swarm chunk progress, and
 the oldest in-flight span — the "which host is the slow one" answer for
 a pod-scale swarm pull, one command instead of N curls.
 
+``--fleet ... --watch SECS`` turns the pod view into a TIME SERIES: every
+interval it polls each host's ``/debug/telemetry`` (the sliding-window
+rate/p99 surface both planes serve) and emits one JSONL line — the
+continuous pod view a long swarm pull needs (pipe to a file, live-tail
+it, or feed it to a plotter). ``--samples N`` bounds the loop (CI and
+scripting); default runs until interrupted.
+
 Usage::
 
     python tools/statusz.py http://127.0.0.1:8800
     python tools/statusz.py /tmp/demodel-flightrec-4242-1.json
     python tools/statusz.py http://127.0.0.1:8800 --validate
     python tools/statusz.py --fleet host-a:8800,host-b:8800,host-c:8800
+    python tools/statusz.py --fleet host-a:8800,host-b:8800 --watch 5
 """
 
 from __future__ import annotations
@@ -48,7 +56,7 @@ from tools.trace_report import stage_breakdown  # noqa: E402
 def load(source: str) -> tuple[dict, str]:
     if source.startswith(("http://", "https://")):
         url = source
-        if "/debug/statusz" not in url:
+        if "/debug/" not in url:  # bare host:port → the statusz document
             url = url.rstrip("/") + "/debug/statusz"
         with urllib.request.urlopen(url, timeout=10) as r:
             return json.loads(r.read()), url
@@ -167,17 +175,109 @@ def validate(doc: dict, source: str) -> None:
             if key not in doc:
                 raise SystemExit(f"{source}: recorder dump missing {key!r}")
         return
+    if doc.get("telemetry") == 1:
+        # the time-series document (Python or native plane)
+        if not isinstance(doc.get("windows"), dict):
+            raise SystemExit(f"{source}: telemetry missing 'windows'")
+        native = doc.get("server") == "demodel-native-proxy"
+        if not native and "windows_s" not in doc.get("windows", {}):
+            raise SystemExit(f"{source}: telemetry missing windows_s")
+        return
     if doc.get("statusz") != 1:
         raise SystemExit(f"{source}: missing/unknown statusz schema version")
     native = doc.get("server") == "demodel-native-proxy"
     required = (("config", "conns", "metrics") if native else
                 ("breakers", "budgets", "inflight_spans", "trace",
-                 "swarm"))
+                 "swarm", "config", "telemetry"))
     for key in required:
         if key not in doc:
             raise SystemExit(f"{source}: statusz missing {key!r}")
     if native and "hist" not in doc["metrics"]:
         raise SystemExit(f"{source}: native metrics missing histograms")
+    if not native:
+        for knob in doc["config"].values():
+            if not (isinstance(knob, dict) and "value" in knob
+                    and knob.get("source") in ("env", "default", "tuner")):
+                raise SystemExit(f"{source}: malformed config knob {knob!r}")
+
+
+def _telemetry_url(host: str) -> str:
+    base = host if host.startswith(("http://", "https://")) \
+        else f"http://{host}"
+    return base.rstrip("/") + "/debug/telemetry"
+
+
+def _host_telemetry_entry(host: str, doc: dict) -> dict:
+    """One host's row in a watch sample: the key windowed series an
+    operator tails — per-family p99s + rates, both planes."""
+    entry: dict = {"host": host, "server": doc.get("server")}
+    windows = doc.get("windows")
+    if isinstance(windows, dict) and "hist" in windows:
+        # Python plane: the hub summary (+ the native mirror when nested)
+        entry["snapshots"] = windows.get("snapshots")
+        entry["p99_30s"] = {
+            name: fam.get("30", {}).get("p99")
+            for name, fam in windows.get("hist", {}).items()}
+        entry["rate_30s"] = {
+            name: fam.get("30")
+            for name, fam in windows.get("rates", {}).items()}
+        native = doc.get("native")
+        if isinstance(native, dict):
+            entry["native_p99_30s"] = {
+                name: fam.get("30", {}).get("p99")
+                for name, fam in native.get("hist", {}).items()}
+    elif isinstance(windows, dict):
+        # native plane: windows["30"][family][route]
+        entry["snapshots"] = doc.get("snapshots")
+        entry["p99_30s"] = {
+            f"{family}{{route={route}}}": spec.get("p99")
+            for family, routes in windows.get("30", {}).items()
+            for route, spec in routes.items()}
+        entry["rate_30s"] = {
+            f"{family}{{route={route}}}": spec.get("rate")
+            for family, routes in windows.get("30", {}).items()
+            for route, spec in routes.items()}
+    return entry
+
+
+def _poll_host(host: str) -> tuple[str, dict | None, str | None]:
+    try:
+        with urllib.request.urlopen(_telemetry_url(host), timeout=10) as r:
+            return host, json.loads(r.read()), None
+    except Exception as e:  # noqa: BLE001 — per-host degrade
+        return host, None, str(e)
+
+
+def watch_fleet(hosts: list[str], interval_s: float,
+                samples: int | None = None, out=None) -> int:
+    """Poll every host's ``/debug/telemetry`` each interval and emit one
+    JSONL line per tick — the continuous pod time series. The polling
+    itself drives each node's snapshot ring, so the windows sharpen as
+    the watch runs. Hosts are polled CONCURRENTLY and the sleep subtracts
+    the poll time: one unreachable host (10 s connect timeout) must not
+    stall the whole tick or starve the other hosts' sampling cadence."""
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+
+    out = out if out is not None else sys.stdout
+    n = 0
+    with ThreadPoolExecutor(max_workers=min(32, max(1, len(hosts)))) as ex:
+        while samples is None or n < samples:
+            t0 = _time.monotonic()
+            tick: dict = {"metric": "telemetry_fleet", "ts": _time.time(),
+                          "interval_s": interval_s, "hosts": [],
+                          "unreachable": []}
+            for host, doc, err in ex.map(_poll_host, hosts):
+                if doc is not None:
+                    tick["hosts"].append(_host_telemetry_entry(host, doc))
+                else:
+                    tick["unreachable"].append({"host": host, "error": err})
+            print(json.dumps(tick, default=str), file=out, flush=True)
+            n += 1
+            if samples is None or n < samples:
+                _time.sleep(max(0.0, interval_s
+                                - (_time.monotonic() - t0)))
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -190,14 +290,26 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--fleet", metavar="HOSTS",
                     help="comma-separated host[:port] list — render the "
                          "one-line pod view instead of a single source")
+    ap.add_argument("--watch", metavar="SECS", type=float,
+                    help="with --fleet: poll /debug/telemetry every SECS "
+                         "and emit a JSONL time series")
+    ap.add_argument("--samples", metavar="N", type=int,
+                    help="with --watch: stop after N samples "
+                         "(default: run until interrupted)")
     args = ap.parse_args(argv)
 
+    if args.watch is not None and args.watch <= 0:
+        ap.error("--watch needs a positive interval")
     if args.fleet:
         hosts = [h.strip() for h in args.fleet.split(",") if h.strip()]
         if not hosts:
             ap.error("--fleet needs at least one host")
+        if args.watch is not None:
+            return watch_fleet(hosts, args.watch, args.samples)
         print(json.dumps(fleet_report(hosts), default=str))
         return 0
+    if args.watch is not None:
+        ap.error("--watch requires --fleet")
     if not args.source:
         ap.error("a source (or --fleet) is required")
 
