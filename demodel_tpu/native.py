@@ -19,6 +19,29 @@ log = get_logger("native")
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
 _SO = _NATIVE_DIR / "build" / "libdemodel_native.so"
 
+#: Python mirror of the native DM_LOCK_ORDER_CHECK rank table
+#: (``native/lock_order.h``) — the canonical answer to "may I call into
+#: the store while holding a proxy lock" from the Python side of the
+#: boundary, without parsing C++ at runtime. Low rank = outermost.
+#: Kept in lockstep by the ``surface-parity`` analyzer rule: an edit to
+#: either side without the other is a build-breaking finding.
+NATIVE_LOCK_RANKS = {
+    "kRankProxyReactor": 6,
+    "kRankProxyQueue": 8,
+    "kRankProxySessions": 10,
+    "kRankProxyFill": 12,
+    "kRankProxyLeaf": 14,
+    "kRankProxyUpstream": 16,
+    "kRankProxyHint": 18,
+    "kRankProxyRestore": 20,
+    "kRankProxyTelemetry": 22,
+    "kRankStoreGc": 30,
+    "kRankStoreWriters": 32,
+    "kRankStoreIndex": 34,
+    "kRankStorePin": 36,
+    "kRankStoreFd": 38,
+}
+
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 

@@ -384,6 +384,10 @@ class PeerSet:
         if peer is None and expected_digest:
             # no peer has this exact key, but one may hold the same CONTENT
             # under a different key — fetch by content address
+            # demodel: allow(atomic-snapshot) — sequential best-effort
+            # lookups, not one snapshot: a locate miss followed by a
+            # digest hit needs no cross-hold consistency (the fetch
+            # itself re-verifies the digest end-to-end)
             hit = self.locate_digest(expected_digest)
             if hit is not None:
                 peer, remote_key = hit
@@ -493,6 +497,9 @@ class PeerSet:
         remote_key = key
         peer = self.locate(key)
         if peer is None and expected_digest:
+            # demodel: allow(atomic-snapshot) — same sequential fallback
+            # as fetch_into above: no cross-hold consistency expected,
+            # the transfer re-verifies the digest
             hit = self.locate_digest(expected_digest)
             if hit is not None:
                 peer, remote_key = hit

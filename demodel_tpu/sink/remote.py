@@ -704,13 +704,18 @@ class SwarmScheduler:
                         hosts=len(self.participants)) as sp:
             assigned = bounded_assign(
                 self.ring, [_swarm_chunk_id(k, i) for k, i in grid])
+            # demodel: allow(atomic-snapshot) — _plan runs from start()
+            # BEFORE any pump thread exists and add_file refuses
+            # post-start registration, so the grid cannot change between
+            # the two holds (single-threaded by lifecycle contract)
             with self._lock:
                 self._primary = {
                     (k, i): assigned[_swarm_chunk_id(k, i)]
                     for k, i in grid}
                 self._owned = [c for c, owner in self._primary.items()
                                if owner == self.self_id]
-            sp.set_attr("owned", len(self._owned))
+                owned_n = len(self._owned)
+            sp.set_attr("owned", owned_n)
 
     def start(self) -> "SwarmScheduler":
         if self._threads:
@@ -1003,6 +1008,10 @@ class SwarmScheduler:
             with self._lock:
                 reowned = self._primary.get((key, index)) != self.self_id
             try:
+                # demodel: allow(atomic-snapshot) — _primary is
+                # write-once at plan time (pre-start), so the reowned
+                # verdict cannot go stale between the holds; the fetch
+                # itself re-claims under the lock before any work
                 self._fetch_origin(key, index, reowned=reowned)
             except IOError as e:
                 log.warning("swarm origin fetch of %s/%d failed: %s "
@@ -1201,8 +1210,15 @@ class SwarmScheduler:
                 with self._cv:
                     self._cv.wait(timeout=self._gossip_s)
                 continue
+            # demodel: allow(atomic-snapshot) — the pick is ADVISORY:
+            # _advertisers re-reads liveness and _fetch_peer's _claim
+            # re-validates inflight/done under the lock before any
+            # bytes move, so a stale pick costs one no-op loop, never
+            # a wrong transfer
             adv = self._advertisers(*target)
             if adv:
+                # demodel: allow(atomic-snapshot) — same advisory pick:
+                # _claim re-validates under the lock before any bytes move
                 self._fetch_peer(*target, adv)
 
     def stats(self) -> dict:

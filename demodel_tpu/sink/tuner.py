@@ -161,6 +161,14 @@ class PullTuner:
         self._round_robin = 0
         self._thread: threading.Thread | None = None
         self._span: Any = trace.NOOP
+        #: serializes the tick thread's knob/bookkeeping WRITES against
+        #: snapshot() (the statusz/bench read surface): without it a
+        #: reader could see decision N's count with decision N-1's knob
+        #: values — a torn document (guarded-field finding, PR 10). The
+        #: fetch hot path (fetch_windows) deliberately stays lock-free:
+        #: its per-window int loads are GIL-atomic and individually
+        #: consistent, which is all a window split needs.
+        self._knob_lock = threading.Lock()
 
     # -- wiring ---------------------------------------------------------
     def _tel(self) -> "metrics.Telemetry":
@@ -180,18 +188,24 @@ class PullTuner:
                    for b in health.describe().values())
 
     def snapshot(self) -> dict[str, Any]:
-        """Live knob values + controller state (statusz / bench)."""
-        return {
-            "streams": self.streams,
-            "window_bytes": self.window_bytes,
-            "prefetch_depth": self.prefetch_depth,
-            "decisions": self.decisions,
-            "best_throughput_bps": round(self._best_thr, 1),
-        }
+        """Live knob values + controller state (statusz / bench) — one
+        CONSISTENT document: taken under the same lock the tick thread
+        writes under, so the decision count always matches the knob
+        values it produced."""
+        with self._knob_lock:
+            return {
+                "streams": self.streams,
+                "window_bytes": self.window_bytes,
+                "window_mb": self.window_bytes >> 20,
+                "prefetch_depth": self.prefetch_depth,
+                "decisions": self.decisions,
+                "best_throughput_bps": round(self._best_thr, 1),
+            }
 
     @property
     def window_mb(self) -> int:
-        return self.window_bytes >> 20
+        with self._knob_lock:
+            return self.window_bytes >> 20
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "PullTuner":
@@ -326,47 +340,51 @@ class PullTuner:
         metrics.HUB.set_gauge("tuner_window_read_p99", p99)
         try:
             now = self._clock()
-            if retry_rate > self.retry_hi or breaker_open:
-                if now >= self._hold_until:
-                    self._backoff("breaker-open" if breaker_open
-                                  else f"retry-rate {retry_rate:.2f}/s")
-                return
-            if now < self._hold_until:
-                return
-            if self._probe is not None:
-                knob, old = self._probe
-                if forced:
-                    # the test seams define the post-probe rate directly
-                    post = thr
-                elif now - self._probe_t >= self.judge_s:
-                    # judge over ONLY the post-raise interval — the
-                    # window_s moving average barely moves per tick and
-                    # would rubber-stamp every probe
-                    post = tel.rate("pull_bytes_total",
-                                    max(now - self._probe_t, 1e-9))
-                else:
-                    return  # let the raise settle before judging
-                self._probe = None
-                if self._probe_base > 0 and post < 0.85 * self._probe_base:
-                    # the raise cost throughput: revert and hold
-                    cur = getattr(self, knob)
-                    self._decide(
-                        "revert", knob, cur, old,
-                        f"thr {post:.0f} < 0.85x {self._probe_base:.0f}")
-                    setattr(self, knob, old)
-                    self._hold_until = now + 4 * self.tick_s
+            # every knob/bookkeeping WRITE below happens under the knob
+            # lock so snapshot() reads one consistent decision state
+            with self._knob_lock:
+                if retry_rate > self.retry_hi or breaker_open:
+                    if now >= self._hold_until:
+                        self._backoff("breaker-open" if breaker_open
+                                      else f"retry-rate {retry_rate:.2f}/s")
                     return
-            self._best_thr = max(self._best_thr, thr)
-            if budget_wait_share > 0.5 and \
-                    self.prefetch_depth > max(1, self.min_prefetch):
-                # admission-bound: deeper prefetch only pins more host RAM
-                new = self.prefetch_depth - 1
-                self._decide("decrease", "prefetch_depth",
-                             self.prefetch_depth, new,
-                             f"budget-wait share {budget_wait_share:.2f}")
-                self.prefetch_depth = new
-                return
-            self._raise_one(thr)
+                if now < self._hold_until:
+                    return
+                if self._probe is not None:
+                    knob, old = self._probe
+                    if forced:
+                        # the test seams define the post-probe rate directly
+                        post = thr
+                    elif now - self._probe_t >= self.judge_s:
+                        # judge over ONLY the post-raise interval — the
+                        # window_s moving average barely moves per tick and
+                        # would rubber-stamp every probe
+                        post = tel.rate("pull_bytes_total",
+                                        max(now - self._probe_t, 1e-9))
+                    else:
+                        return  # let the raise settle before judging
+                    self._probe = None
+                    if self._probe_base > 0 \
+                            and post < 0.85 * self._probe_base:
+                        # the raise cost throughput: revert and hold
+                        cur = getattr(self, knob)
+                        self._decide(
+                            "revert", knob, cur, old,
+                            f"thr {post:.0f} < 0.85x {self._probe_base:.0f}")
+                        setattr(self, knob, old)
+                        self._hold_until = now + 4 * self.tick_s
+                        return
+                self._best_thr = max(self._best_thr, thr)
+                if budget_wait_share > 0.5 and \
+                        self.prefetch_depth > max(1, self.min_prefetch):
+                    # admission-bound: deeper prefetch pins more host RAM
+                    new = self.prefetch_depth - 1
+                    self._decide("decrease", "prefetch_depth",
+                                 self.prefetch_depth, new,
+                                 f"budget-wait share {budget_wait_share:.2f}")
+                    self.prefetch_depth = new
+                    return
+                self._raise_one(thr)
         finally:
             # gauges reflect the POST-decision knob values — the scrape
             # and statusz must agree with what the fetch loop will use

@@ -303,6 +303,9 @@ class Telemetry:
         self._clock = clock
         self._lock = threading.Lock()
         self._ring: list[dict[str, Any]] = []
+        #: a freshen() in flight has CLAIMED the next sample — concurrent
+        #: freshens return instead of double-sampling (min-gap contract)
+        self._freshening = False
         self.samples_taken = 0
         self.samples_failed = 0
 
@@ -338,12 +341,26 @@ class Telemetry:
     def freshen(self, max_age_s: float | None = None) -> None:
         """Sample unless the newest snapshot is younger than the gap —
         how poll-driven consumers keep the ring current without a
-        dedicated thread (and without flooding it under rapid polls)."""
+        dedicated thread (and without flooding it under rapid polls).
+
+        The staleness check and the claim to sample happen under ONE
+        lock hold (``_freshening`` is the claim): two consumers polling
+        the same stale ring used to BOTH pass the check-then-act gap
+        test and land two back-to-back snapshots, violating the min-gap
+        contract the ring's sizing assumes (atomic-snapshot finding,
+        PR 10 — the scrape itself still runs outside the lock)."""
         gap = max_age_s if max_age_s is not None else self.min_gap_s
         with self._lock:
             newest = self._ring[-1]["ts"] if self._ring else None
-        if newest is None or self._clock() - newest >= gap:
+            if self._freshening or (newest is not None
+                                    and self._clock() - newest < gap):
+                return
+            self._freshening = True
+        try:
             self.sample()
+        finally:
+            with self._lock:
+                self._freshening = False
 
     def clear(self) -> None:
         with self._lock:

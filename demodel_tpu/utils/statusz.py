@@ -146,15 +146,17 @@ def effective_config() -> dict[str, dict[str, Any]]:
     ``tuner`` (a live adaptive tuner is overriding it), ``env`` (the
     operator pinned it), or ``default``."""
     tuner = _active_tuner()
+    # ONE consistent read of the live tuner state: snapshot() serializes
+    # with the tick thread's writes — per-attribute getattr reads could
+    # mix two adjacent decisions' knob values in one config document
+    snap: dict[str, Any] = tuner.snapshot() if tuner is not None else {}
     out: dict[str, dict[str, Any]] = {}
     for env_var, resolved in _knob_rows():
         source = "env" if os.environ.get(env_var, "").strip() else "default"
         value: Any = resolved
         attr = _TUNED_KNOBS.get(env_var)
-        if tuner is not None and attr is not None:
-            tuned = getattr(tuner, attr, None)
-            if tuned is not None:
-                value, source = tuned, "tuner"
+        if attr is not None and attr in snap:
+            value, source = snap[attr], "tuner"
         out[env_var] = {"value": value, "source": source}
     return out
 

@@ -1,0 +1,48 @@
+"""guarded-field golden fixture: fields shared with a worker thread.
+
+``pump`` escapes to a worker (``ex.submit``), so its writes are
+concurrent with every other access: the unguarded counter bump and the
+lock-guarded dict write both race their unguarded readers. The guarded
+and alias-guarded fields are the controls that must stay silent.
+"""
+
+import threading
+
+
+class RaceyCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mu = self._lock          # alias: one lock, two names
+        self.hits = 0
+        self.state = {}
+        self.total = 0
+        self.aliased = 0
+
+    def pump(self):                    # submitted to a worker below
+        self.hits += 1                 # WRITE, no lock — races report()
+        with self._lock:
+            self.state["k"] = 1        # guarded write, UNGUARDED read below
+        with self._lock:
+            self.total += 1            # guarded write
+        with self._mu:
+            self.aliased += 1          # guarded via the ALIAS — silent
+
+    def report(self):
+        return self.hits               # unguarded read (race pair)
+
+    def peek(self):
+        return len(self.state)         # unguarded read (race pair)
+
+    def totals(self):
+        with self._lock:
+            return self.total          # guarded read — silent
+
+    def alias_read(self):
+        with self._lock:
+            return self.aliased        # same lock through the other name
+
+
+def spawn(ex):
+    c = RaceyCache()
+    ex.submit(c.pump)
+    return c.report()

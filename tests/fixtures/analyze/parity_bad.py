@@ -1,0 +1,26 @@
+# demodel: parity-native=parity_native
+"""surface-parity golden fixture: every drift class against the fake
+native tree in ``parity_native/`` — knob default/type drift, one knob
+resolved with two Python defaults, gauge/counter typing disagreement,
+and a lock-rank mirror that lies."""
+
+from demodel_tpu.utils.env import env_int
+
+
+def resolve():
+    gap = env_int("DEMODEL_FAKE_MIN_GAP_MS", 250, minimum=1)
+    flag = env_int("DEMODEL_FAKE_FLAG", 1)
+    depth = env_int("DEMODEL_FAKE_DEPTH", 4)
+    once = env_int("DEMODEL_FAKE_TWICE", 5)
+    again = env_int("DEMODEL_FAKE_TWICE", 7)
+    return gap, flag, depth, once, again
+
+
+PROXY_GAUGES = frozenset({"depth", "reqs", "phantom"})
+
+NATIVE_LOCK_RANKS = {
+    "kRankA": 6,
+    "kRankDup": 6,
+    "kRankB": 7,
+    "kRankExtra": 99,
+}

@@ -1,0 +1,9 @@
+// Miniature fake native tree for the surface-parity golden fixture.
+// kRankB deliberately disagrees with the Python mirror; kRankDup shares
+// kRankA's rank; kRankGone has no mirror entry.
+#pragma once
+
+constexpr int kRankA = 6;
+constexpr int kRankDup = 6;
+constexpr int kRankB = 8;
+constexpr int kRankGone = 9;

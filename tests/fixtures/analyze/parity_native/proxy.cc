@@ -1,0 +1,39 @@
+// Miniature fake native plane for the surface-parity golden fixture:
+// one int knob whose fallback default drifts from the Python side, one
+// bool knob Python types as int, a gauge/counter split PROXY_GAUGES
+// disagrees with, and a hist family the telemetry table never windows.
+static int env_pos_int(const char *, int);
+
+void resolve_knobs() {
+  int min_ms = env_pos_int("DEMODEL_FAKE_MIN_GAP_MS", 600000);
+  if (min_ms == 0) min_ms = 999;
+  int depth = env_pos_int("DEMODEL_FAKE_DEPTH");
+  if (depth <= 0) depth = 4;
+}
+
+static bool env_flag_on() {
+  const char *v = ::getenv("DEMODEL_FAKE_FLAG");
+  if (!v || !*v) return true;
+  return *v != '0';
+}
+
+std::string Metrics::json() const {
+  snprintf(buf, sizeof buf,
+           "{\"reqs\":%llu,\"depth\":%llu,\"lost_gauge\":%llu}");
+  return buf;
+}
+
+std::string Proxy::metrics_json() {
+  metrics_.depth = live();
+  metrics_.lost_gauge = parked();
+  return metrics_.json();
+}
+
+std::string Metrics::hist_json() const {
+  append_hist_family(&out, "serve_request_seconds", route_latency);
+  append_hist_family(&out, "orphan_seconds", route_ttfb);
+  return out;
+}
+
+static const char *const kTelemetryFamilyNames[] = {
+    "serve_request_seconds"};

@@ -1,0 +1,44 @@
+"""atomic-snapshot golden fixture: one logical operation split across
+two holds of the same lock — by data flow (a value derived under the
+first hold consumed under the second) and by control flow (a guard
+derived under the first hold deciding whether the second runs). The
+double-checked-locking control re-derives under the second hold and
+must stay silent.
+"""
+
+import threading
+
+_lock = threading.Lock()
+_items: list = []
+
+
+def torn_copy():
+    with _lock:
+        n = len(_items)
+    # a concurrent append/clear between the holds makes n stale
+    with _lock:
+        return _items[:n]
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring: list = []
+
+    def freshen(self):
+        with self._lock:
+            newest = self._ring[-1] if self._ring else None
+        if newest is None:
+            self.sample()              # check-then-act across two holds
+
+    def sample(self):
+        with self._lock:
+            self._ring.append(1)
+
+    def dclp(self):
+        with self._lock:
+            cur = list(self._ring)
+        if not cur:
+            with self._lock:
+                cur = list(self._ring)  # re-derived: the fix, not the bug
+        return cur

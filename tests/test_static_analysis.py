@@ -41,6 +41,10 @@ ALL_RULES = {
     "wire-call-policy",
     "metric-hygiene",
     "swarm-owner-only-origin",
+    # the PR 10 concurrency plane
+    "guarded-field",
+    "atomic-snapshot",
+    "surface-parity",
 }
 
 #: fixture file → exact expected (rule, line) findings
@@ -127,6 +131,28 @@ GOLDEN = {
     },
     "submit_bad.py": {
         ("no-blocking-io-under-lock", 26),
+    },
+    # the concurrency plane: RacerD-style lock-set races (worker-escaping
+    # write vs unguarded read; guarded + alias-guarded controls silent),
+    # torn snapshots across two holds of one lock (data + guard flow;
+    # double-checked-locking control silent), and native↔Python surface
+    # drift against the miniature fake native tree in parity_native/
+    "guarded_bad.py": {
+        ("guarded-field", 22),
+        ("guarded-field", 24),
+    },
+    "snapshot_bad.py": {
+        ("atomic-snapshot", 19),
+        ("atomic-snapshot", 32),
+    },
+    "parity_bad.py": {
+        ("surface-parity", 11),   # knob default drift native↔Python
+        ("surface-parity", 12),   # knob type drift (int vs bool)
+        ("surface-parity", 15),   # one knob, two Python defaults
+        ("surface-parity", 19),   # PROXY_GAUGES: phantom/counter/missing
+        ("surface-parity", 21),   # rank mirror: drift/stale/missing
+        ("surface-parity", 7),    # parity_native/lock_order.h: dup rank
+        ("surface-parity", 8),    # parity_native/proxy.cc: unwindowed hist
     },
     # the cross-module taint pair: silent when analyzed alone (neither
     # half shows both the device producer and the sync) — the findings
@@ -482,16 +508,21 @@ def test_result_cache_roundtrip_and_invalidation(tmp_path):
 
 
 def test_warm_cache_is_subsecond():
-    """The tier-1 gate contract: a warm full-tree run finishes fast."""
+    """The tier-1 gate contract: a warm full-tree run finishes fast —
+    the ANALYSIS phase (the driver's own secs, interpreter startup
+    excluded) stays under the 0.5 s acceptance bound."""
+    import re
     import time
 
-    _run_cli(["demodel_tpu"], REPO)  # ensure the entry exists
+    _run_cli(["demodel_tpu"], REPO)  # ensure the entries exist
     t0 = time.perf_counter()
     warm = _run_cli(["--stats", "demodel_tpu"], REPO)
     secs = time.perf_counter() - t0
     assert warm.returncode == 0, warm.stdout + warm.stderr
     assert "cache: hit" in warm.stderr
-    assert secs < 1.0, f"warm analyze run took {secs:.2f}s"
+    assert secs < 1.0, f"warm analyze run took {secs:.2f}s wall"
+    m = re.search(r"secs: ([0-9.]+)", warm.stderr)
+    assert m and float(m.group(1)) < 0.5, warm.stderr
 
 
 def test_sarif_output(tmp_path):
@@ -554,3 +585,245 @@ def test_changed_only_scopes_reporting(tmp_path):
     assert out.returncode == 1
     assert "dirty_mod.py:4" in out.stdout
     assert "clean_mod.py" not in out.stdout
+
+
+# ------------------------------------------- concurrency plane (PR 10)
+
+
+def test_guarded_field_fires_across_modules(tmp_path):
+    """The worker-escape evidence lives in ANOTHER module: a class whose
+    write method is submitted to an executor in file B races its
+    unguarded reader in file A — invisible to either file alone."""
+    (tmp_path / "cache_mod.py").write_text(
+        "import threading\n"
+        "class Cache:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.hits = 0\n"
+        "    def pump(self):\n"
+        "        self.hits += 1\n"          # line 7: unguarded write
+        "    def report(self):\n"
+        "        return self.hits\n"
+    )
+    (tmp_path / "driver_mod.py").write_text(
+        "from cache_mod import Cache\n"
+        "def run(ex):\n"
+        "    c = Cache()\n"
+        "    ex.submit(c.pump)\n"
+        "    return c.report()\n"
+    )
+    active, _ = analyze_paths([tmp_path], rule_ids=["guarded-field"],
+                              root=tmp_path)
+    hits = [(f.rule, f.path, f.line) for f in active]
+    assert ("guarded-field", "cache_mod.py", 7) in hits, hits
+    # and the submit EVIDENCE is named in the blame
+    msg = next(f.message for f in active if f.line == 7)
+    assert "driver_mod.py:4" in msg, msg
+
+    # control: the same pair analyzed WITHOUT the driver is silent —
+    # no worker evidence, no speculative concurrency
+    active, _ = analyze_paths([tmp_path / "cache_mod.py"],
+                              rule_ids=["guarded-field"], root=tmp_path)
+    assert active == [], [f.render() for f in active]
+
+
+def test_guarded_field_silent_through_aliased_lock(tmp_path):
+    """Lock sets intersect through an ALIASED lock attribute: the write
+    holds self._lock, the read holds self._mu (= self._lock) or
+    self._cv (= Condition(self._lock)) — one lock, three names, no
+    race. A genuinely foreign lock on the reader still fires."""
+    (tmp_path / "aliased.py").write_text(
+        "import threading\n"
+        "class Guarded:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._mu = self._lock\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "        self.n = 0\n"
+        "    def pump(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "    def read_mu(self):\n"
+        "        with self._mu:\n"
+        "            return self.n\n"
+        "    def read_cv(self):\n"
+        "        with self._cv:\n"
+        "            return self.n\n"
+        "def run(ex):\n"
+        "    g = Guarded()\n"
+        "    ex.submit(g.pump)\n"
+    )
+    active, _ = analyze_paths([tmp_path], rule_ids=["guarded-field"],
+                              root=tmp_path)
+    assert active == [], [f.render() for f in active]
+
+    # control: a DIFFERENT lock on the reader is a disjoint lock set
+    (tmp_path / "aliased.py").write_text(
+        (tmp_path / "aliased.py").read_text().replace(
+            "        self._mu = self._lock\n",
+            "        self._mu = threading.Lock()\n"))
+    active, _ = analyze_paths([tmp_path], rule_ids=["guarded-field"],
+                              root=tmp_path)
+    assert any(f.rule == "guarded-field" for f in active), \
+        "disjoint lock sets must still race"
+
+
+def test_guarded_field_multi_instance_worker_races_itself(tmp_path):
+    """A method submitted in a LOOP runs as N concurrent instances —
+    its own unguarded write races itself. The same method submitted
+    once is one thread and must stay silent."""
+    src = (
+        "import threading\n"
+        "class Filler:\n"
+        "    def __init__(self):\n"
+        "        self.done = 0\n"
+        "    def work(self):\n"
+        "        self.done += 1\n"            # line 6
+        "def run(ex):\n"
+        "    f = Filler()\n"
+        "    for _ in range(4):\n"
+        "        ex.submit(f.work)\n"
+    )
+    (tmp_path / "mod.py").write_text(src)
+    active, _ = analyze_paths([tmp_path], rule_ids=["guarded-field"],
+                              root=tmp_path)
+    assert [(f.rule, f.line) for f in active] == [("guarded-field", 6)], [
+        f.render() for f in active]
+
+    (tmp_path / "mod.py").write_text(src.replace(
+        "    for _ in range(4):\n        ex.submit(f.work)\n",
+        "    ex.submit(f.work)\n"))
+    active, _ = analyze_paths([tmp_path], rule_ids=["guarded-field"],
+                              root=tmp_path)
+    assert active == [], [f.render() for f in active]
+
+
+def test_atomic_snapshot_composes_through_the_call_graph(tmp_path):
+    """The two holds need not be literal with-blocks: a value returned
+    by one lock-acquiring self-method and consumed by a second is the
+    same torn-snapshot shape (the Telemetry.summary() bug)."""
+    (tmp_path / "ring.py").write_text(
+        "import threading\n"
+        "class Ring:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []\n"
+        "    def count(self):\n"
+        "        with self._lock:\n"
+        "            return len(self._items)\n"
+        "    def take(self, n):\n"
+        "        with self._lock:\n"
+        "            return self._items[:n]\n"
+        "    def torn(self):\n"
+        "        n = self.count()\n"
+        "        return self.take(n)\n"       # line 14
+    )
+    active, _ = analyze_paths([tmp_path], rule_ids=["atomic-snapshot"],
+                              root=tmp_path)
+    assert [(f.rule, f.line) for f in active] == [
+        ("atomic-snapshot", 14)], [f.render() for f in active]
+
+
+def test_rule_key_isolates_pass_edits():
+    """Editing ONE pass module changes only that rule's cache key —
+    the per-rule invalidation contract (satellite: analyzer result
+    cache keyed on rule-version strings)."""
+    import os
+
+    import tools.analyze.passes as passes_pkg  # noqa: F401 — registry
+    from tools.analyze import cache
+    from tools.analyze.passes import excepts
+
+    files = [REPO / "demodel_tpu" / "config.py"]
+    before = {rid: cache.rule_key(files, rid, None)
+              for rid in ("no-bare-except", "guarded-field")}
+    src = Path(excepts.__file__)
+    st = src.stat()
+    try:
+        os.utime(src, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+        after = {rid: cache.rule_key(files, rid, None)
+                 for rid in ("no-bare-except", "guarded-field")}
+    finally:
+        os.utime(src, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert before["no-bare-except"] != after["no-bare-except"]
+    assert before["guarded-field"] == after["guarded-field"]
+
+    # bumping a rule's VERSION string invalidates it the same way
+    from tools.analyze.core import REGISTRY
+    cls = REGISTRY["no-bare-except"]
+    old = cls.version
+    try:
+        cls.version = old + ".test"
+        assert cache.rule_key(files, "no-bare-except", None) \
+            != before["no-bare-except"]
+    finally:
+        cls.version = old
+
+
+def test_cache_partial_invalidation_via_cli(tmp_path):
+    """Touching one pass module turns a warm run into a PARTIAL hit
+    (only that rule recomputes) with byte-identical findings."""
+    import os
+
+    from tools.analyze.passes import excepts
+
+    src = tmp_path / "mod.py"
+    src.write_text("def f(fetch):\n"
+                   "    try:\n"
+                   "        return fetch()\n"
+                   "    except:\n"
+                   "        return None\n")
+    cold = _run_cli(["--stats", "mod.py"], tmp_path)
+    assert "cache: miss" in cold.stderr, cold.stderr
+    warm = _run_cli(["--stats", "mod.py"], tmp_path)
+    assert "cache: hit" in warm.stderr, warm.stderr
+    passmod = Path(excepts.__file__)
+    st = passmod.stat()
+    try:
+        os.utime(passmod, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+        partial = _run_cli(["--stats", "mod.py"], tmp_path)
+    finally:
+        os.utime(passmod, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert "cache: partial" in partial.stderr, partial.stderr
+    assert partial.stdout == warm.stdout  # identical findings replayed
+    assert "mod.py:4 no-bare-except" in partial.stdout
+
+
+def test_surface_parity_cache_key_digests_native_inputs(tmp_path):
+    """Review finding (PR 10): surface-parity reads native/*.{h,cc} in
+    finalize(), so those files MUST be part of its cache key — a rank
+    edit in lock_order.h alone used to leave a warm `cache: hit`
+    silently blessing the drift. Other rules must NOT invalidate."""
+    import os
+    import shutil
+
+    import tools.analyze.passes  # noqa: F401 — registry
+    from tools.analyze import cache
+
+    fixture = FIXTURES / "parity_bad.py"
+    native = FIXTURES / "parity_native"
+    shutil.copy(fixture, tmp_path / "parity_bad.py")
+    shutil.copytree(native, tmp_path / "parity_native")
+    files = [tmp_path / "parity_bad.py"]
+
+    before = {rid: cache.rule_key(files, rid, None)
+              for rid in ("surface-parity", "no-bare-except")}
+    hdr = tmp_path / "parity_native" / "lock_order.h"
+    st = hdr.stat()
+    os.utime(hdr, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    after = {rid: cache.rule_key(files, rid, None)
+             for rid in ("surface-parity", "no-bare-except")}
+    assert before["surface-parity"] != after["surface-parity"]
+    assert before["no-bare-except"] == after["no-bare-except"]
+
+    # and end-to-end through the CLI cache: a CONTENT edit to the fake
+    # native tree changes the warm run's findings
+    cold = _run_cli(["--stats", "parity_bad.py"], tmp_path)
+    assert "cache: miss" in cold.stderr
+    hdr.write_text(hdr.read_text().replace(
+        "constexpr int kRankB = 8;", "constexpr int kRankB = 7;"))
+    edited = _run_cli(["--stats", "parity_bad.py"], tmp_path)
+    assert "kRankB" not in "".join(
+        ln for ln in edited.stdout.splitlines() if "= 7 but" in ln), \
+        "mirror now matches: the rank-drift finding must be gone"
+    assert edited.stdout != cold.stdout

@@ -426,3 +426,55 @@ def test_hub_reset_clears_the_ring():
     assert len(m.HUB.telemetry()) == 1
     m.HUB.reset()
     assert len(m.HUB.telemetry()) == 0
+
+
+def test_concurrent_freshens_take_one_sample():
+    """Regression (PR 10, atomic-snapshot finding): freshen()'s staleness
+    check and its decision to sample used to live under two separate
+    lock holds — two consumers polling one stale ring would BOTH pass
+    the gap test and land back-to-back snapshots, violating the min-gap
+    contract. Deterministic: the first freshen blocks inside the scrape,
+    the second must return without sampling."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    def blocking_source():
+        entered.set()
+        assert release.wait(5), "test wiring: scrape never released"
+        return {"counters": {"c": 1.0}, "gauges": {}, "hists": {}}
+
+    tel = m.Telemetry(blocking_source, cap=8, min_gap_s=30.0,
+                      clock=lambda: 100.0)
+    t1 = threading.Thread(target=tel.freshen, daemon=True)
+    t1.start()
+    assert entered.wait(5)
+    # ring still empty and stale — the OLD check-then-act would sample
+    # again here; the claim flag must make this a no-op
+    before = tel.samples_taken
+    tel.freshen()
+    assert tel.samples_taken == before, \
+        "second freshen sampled while the first was mid-scrape"
+    release.set()
+    t1.join(timeout=5)
+    assert tel.samples_taken == 1
+    assert len(tel) == 1
+    # and the claim is RELEASED: a later stale poll samples again
+    tel.min_gap_s = 0.0
+    tel.freshen()
+    assert tel.samples_taken == 2
+
+
+def test_freshen_claim_survives_a_raising_source():
+    """A scrape that raises must release the freshen claim — otherwise
+    one dead source wedges the ring forever."""
+    calls = {"n": 0}
+
+    def source():
+        calls["n"] += 1
+        raise RuntimeError("source down")
+
+    tel = m.Telemetry(source, cap=8, min_gap_s=0.0, clock=lambda: 100.0)
+    tel.freshen()
+    assert tel.samples_failed == 1
+    tel.freshen()  # the claim from the failed attempt must not linger
+    assert calls["n"] == 2 and tel.samples_failed == 2

@@ -12,6 +12,7 @@ each decision visible as a span event and ``tuner_*`` gauges.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 
 import numpy as np
@@ -307,3 +308,86 @@ def test_tuned_pull_over_real_peer(tmp_path, monkeypatch):
         assert m.HUB.get_gauge("tuner_throughput_bps") > 0
     finally:
         node.stop()
+
+
+def test_snapshot_serializes_with_the_tick_thread():
+    """Regression (PR 10, guarded-field finding): snapshot() must read
+    under the SAME lock the tick thread writes under — a reader used to
+    see decision N's count paired with decision N-1's knob values. The
+    lock discipline is asserted deterministically: a held knob lock
+    blocks snapshot() until released."""
+    t = _tuner()
+    done = threading.Event()
+    out: dict = {}
+
+    def read():
+        out.update(t.snapshot())
+        done.set()
+
+    with t._knob_lock:  # noqa: SLF001 — the lock IS the contract under test
+        reader = threading.Thread(target=read, daemon=True)
+        reader.start()
+        assert not done.wait(0.2), \
+            "snapshot() completed while the knob lock was held"
+    assert done.wait(2.0)
+    reader.join(timeout=2)
+    assert out["streams"] == t.streams
+    assert out["window_mb"] == out["window_bytes"] >> 20
+
+
+def test_snapshot_is_decision_consistent_under_concurrent_ticks():
+    """Hammer forced ticks on one thread while snapshotting on another:
+    every snapshot's decision count must agree with the knob state that
+    decision produced (the torn read the knob lock exists to prevent).
+    The writer keeps streams = min + (decisions % 2) as its invariant."""
+    t = _tuner()
+    t.min_streams = t.streams = 1
+    t.max_streams = 2
+    t.max_window = t.window_bytes      # pin: only the streams knob moves
+    t.max_prefetch = t.prefetch_depth  # pin
+    stop = threading.Event()
+
+    def churn():
+        while not stop.is_set():
+            # alternate probe-up / revert: each is one decision moving
+            # streams between 1 and 2 in lockstep with the count
+            t.tick(thr=1000.0, retry_rate=0.0, breaker_open=False,
+                   budget_wait_share=0.0)
+            t.tick(thr=1.0, retry_rate=0.0, breaker_open=False,
+                   budget_wait_share=0.0)
+
+    w = threading.Thread(target=churn, daemon=True)
+    w.start()
+    try:
+        for _ in range(400):
+            snap = t.snapshot()
+            assert snap["streams"] == 1 + (snap["decisions"] % 2), snap
+    finally:
+        stop.set()
+        w.join(timeout=5)
+
+
+def test_statusz_reads_tuner_knobs_via_snapshot(monkeypatch):
+    """statusz's effective-config must take ONE consistent tuner
+    snapshot, not per-attribute reads that can straddle a decision."""
+    from demodel_tpu.sink.tuner import _register, _unregister
+    from demodel_tpu.utils import statusz
+
+    t = _tuner()
+    _register(t)  # visible to statusz without a live tick thread
+    try:
+        calls = {"n": 0}
+        real = t.snapshot
+
+        def counted():
+            calls["n"] += 1
+            return real()
+
+        monkeypatch.setattr(t, "snapshot", counted)
+        cfg = statusz.effective_config()
+        assert calls["n"] == 1, "effective_config must snapshot exactly once"
+        assert cfg["DEMODEL_PEER_STREAMS"]["source"] == "tuner"
+        assert cfg["DEMODEL_PEER_STREAMS"]["value"] == real()["streams"]
+        assert cfg["DEMODEL_PULL_WINDOW_MB"]["value"] == real()["window_mb"]
+    finally:
+        _unregister(t)

@@ -149,25 +149,53 @@ def main(argv: list[str] | None = None) -> int:
 
     t0 = time.perf_counter()
     cache_state = "off"
-    active = suppressed = None
-    key = None
-    if not args.no_cache:
-        from tools.analyze import cache
-
-        key = cache.run_key(files, args.rule or None, report_only)
-        hit = cache.load(root, key)
-        if hit is not None:
-            active, suppressed = hit
-            cache_state = "hit"
-        else:
-            cache_state = "miss"
-    if active is None:
+    sort_key = lambda f: (f.path, f.line, f.rule)  # noqa: E731
+    if args.no_cache:
         active, suppressed = analyze_paths(
             paths, rule_ids=args.rule or None, report_only=report_only)
-        if key is not None:
-            from tools.analyze import cache
+    else:
+        import tools.analyze.passes  # noqa: F401 — rule→module mapping
+        from tools.analyze import cache
 
-            cache.store(root, key, active, suppressed)
+        rule_ids = sorted(args.rule) if args.rule else sorted(REGISTRY)
+        want = rule_ids + [cache.PARSE_RULE]
+        keys = {rid: cache.rule_key(files, rid, report_only)
+                for rid in want}
+        hits: dict[str, tuple[list, list]] = {}
+        missing: list[str] = []
+        for rid in want:
+            got = cache.load_rule(root, keys[rid])
+            (hits.__setitem__(rid, got) if got is not None
+             else missing.append(rid))
+        if missing:
+            # one analysis run covers every missed rule (the index is
+            # built once); parse errors come free with any run
+            run_rules = [r for r in missing if r != cache.PARSE_RULE] \
+                or rule_ids
+            run_a, run_s = analyze_paths(
+                paths, rule_ids=run_rules, report_only=report_only)
+            fresh: dict[str, tuple[list, list]] = {
+                rid: ([], []) for rid in
+                set(missing) | set(run_rules) | {cache.PARSE_RULE}}
+            for bucket, found in ((0, run_a), (1, run_s)):
+                for f in found:
+                    rid = cache.PARSE_RULE if f.rule == "parse-error" \
+                        else f.rule
+                    if rid in fresh:
+                        fresh[rid][bucket].append(f)
+            cache.store_rules(root, {
+                keys[rid]: (rid, a, s)
+                for rid, (a, s) in fresh.items() if rid in keys})
+            for rid in missing:
+                hits[rid] = fresh.get(rid, ([], []))
+        cache_state = ("hit" if not missing
+                       else "miss" if len(missing) == len(want)
+                       else f"partial ({len(want) - len(missing)}"
+                            f"/{len(want)})")
+        active = sorted((f for a, _ in hits.values() for f in a),
+                        key=sort_key)
+        suppressed = sorted((f for _, s in hits.values() for f in s),
+                            key=sort_key)
     secs = time.perf_counter() - t0
 
     bad_sup: list[str] = []

@@ -1,18 +1,26 @@
 """Result cache: sub-second warm runs for the tier-1 analyze gate.
 
-The unit of caching is the WHOLE run, keyed by every input that can
-change its output: the (path, mtime, size) triple of every analyzed
-file, the analyzer's own sources (same triples — editing a pass
-invalidates), the rule selection, and the report filter. Any change
-recomputes everything; a hit replays the stored findings. That makes the
-cache trivially sound for interprocedural rules — a per-file cache would
-have to reason about which summaries a cross-module edit invalidates,
-and a wrong answer there silently hides findings.
+The unit of caching is one RULE's findings over one input set. Each
+rule's key digests every input that can change its output:
+
+- the (path, mtime, size) triple of every analyzed file — any source
+  edit invalidates every rule (a cross-module edit can change any
+  interprocedural finding, and a per-file cache that tried to be
+  smarter would have to reason about summary invalidation, where a
+  wrong answer silently hides findings);
+- the SHARED analyzer framework sources (core/index/driver/cache/
+  sarif) — framework edits invalidate everything;
+- the rule's OWN pass module (path, mtime, size) plus its declared
+  ``version`` string — editing one pass re-runs only that pass, so a
+  rule-development loop pays one rule's cost, not sixteen;
+- the report filter (``--changed-only``).
+
+Parse errors are file-level, not rule-level — they live under the
+pseudo-rule ``__parse__`` keyed on the framework sources.
 
 The store is a small JSON file at the repo root
-(``.demodel-analyze-cache.json``, gitignored), capped at a handful of
-entries (LRU) so switching between ``demodel_tpu`` and fixture runs does
-not thrash.
+(``.demodel-analyze-cache.json``, gitignored), LRU-capped so switching
+between ``demodel_tpu`` and fixture runs does not thrash.
 """
 
 from __future__ import annotations
@@ -20,13 +28,31 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import sys
 from pathlib import Path
 
 from tools.analyze.core import Finding
 
 CACHE_NAME = ".demodel-analyze-cache.json"
-MAX_ENTRIES = 6
+#: rules × a few distinct path-sets
+MAX_ENTRIES = 96
 _TOOL_DIR = Path(__file__).resolve().parent
+
+#: the pseudo-rule holding file-level parse errors
+PARSE_RULE = "__parse__"
+
+#: framework sources shared by every rule — an edit here invalidates
+#: the whole cache (passes/__init__.py included: it defines the
+#: registration set itself)
+_SHARED = [
+    _TOOL_DIR / "core.py",
+    _TOOL_DIR / "index.py",
+    _TOOL_DIR / "cache.py",
+    _TOOL_DIR / "sarif.py",
+    _TOOL_DIR / "__main__.py",
+    _TOOL_DIR / "__init__.py",
+    _TOOL_DIR / "passes" / "__init__.py",
+]
 
 
 def _stat_triples(files) -> list:
@@ -41,13 +67,35 @@ def _stat_triples(files) -> list:
     return out
 
 
-def run_key(files, rule_ids, report_only) -> str:
-    """Digest of everything that determines a run's findings."""
-    tool_files = sorted(_TOOL_DIR.rglob("*.py"))
+def _pass_source(rule_id: str) -> tuple[Path | None, str]:
+    """(pass module file, rule version) for one registered rule."""
+    from tools.analyze.core import REGISTRY
+
+    cls = REGISTRY.get(rule_id)
+    if cls is None:
+        return None, ""
+    mod = sys.modules.get(cls.__module__)
+    f = getattr(mod, "__file__", None)
+    return (Path(f) if f else None), str(getattr(cls, "version", "1"))
+
+
+def rule_key(files, rule_id: str, report_only) -> str:
+    """Digest of everything that determines ONE rule's findings —
+    including any NON-Python inputs the pass declares (surface-parity's
+    native tree: a rank edit in lock_order.h must invalidate its
+    entry, or the warm gate silently blesses drift)."""
+    from tools.analyze.core import REGISTRY
+
+    own, version = _pass_source(rule_id)
+    cls = REGISTRY.get(rule_id)
+    extra = cls.cache_extra_inputs(files) if cls is not None else []
     payload = {
+        "rule": rule_id,
+        "version": version,
         "files": _stat_triples(files),
-        "tool": _stat_triples(tool_files),
-        "rules": sorted(rule_ids) if rule_ids else None,
+        "shared": _stat_triples(_SHARED),
+        "own": _stat_triples([own] if own is not None else []),
+        "extra": _stat_triples(extra),
         # None (no filter) and set() (filter matching nothing) are
         # different runs with different outputs — must not share a key
         "report_only": sorted(report_only) if report_only is not None
@@ -61,13 +109,18 @@ def _cache_path(root: Path) -> Path:
     return Path(root) / CACHE_NAME
 
 
-def load(root: Path, key: str):
-    """``(active, suppressed)`` lists for ``key``, or None on miss."""
+def _read(root: Path) -> list:
     try:
         data = json.loads(_cache_path(root).read_text())
     except (OSError, ValueError):
-        return None
-    for entry in data.get("entries", []):
+        return []
+    entries = data.get("entries", [])
+    return entries if isinstance(entries, list) else []
+
+
+def load_rule(root: Path, key: str):
+    """``(active, suppressed)`` for one rule key, or None on miss."""
+    for entry in _read(root):
         if entry.get("key") == key:
             try:
                 return (
@@ -79,19 +132,17 @@ def load(root: Path, key: str):
     return None
 
 
-def store(root: Path, key: str, active, suppressed) -> None:
+def store_rules(root: Path, results: dict) -> None:
+    """Persist ``{key: (rule, active, suppressed)}`` entries (LRU)."""
     path = _cache_path(root)
-    try:
-        data = json.loads(path.read_text())
-        entries = [e for e in data.get("entries", [])
-                   if e.get("key") != key]
-    except (OSError, ValueError):
-        entries = []
-    entries.append({
-        "key": key,
-        "active": [vars(f) for f in active],
-        "suppressed": [vars(f) for f in suppressed],
-    })
+    entries = [e for e in _read(root) if e.get("key") not in results]
+    for key, (rule, active, suppressed) in results.items():
+        entries.append({
+            "key": key,
+            "rule": rule,
+            "active": [vars(f) for f in active],
+            "suppressed": [vars(f) for f in suppressed],
+        })
     entries = entries[-MAX_ENTRIES:]
     try:
         tmp = path.with_suffix(".tmp")

@@ -96,6 +96,20 @@ class Pass:
 
     id = ""
     description = ""
+    #: bumped when a rule's SEMANTICS change without its module's source
+    #: changing (e.g. behavior keyed on data files) — part of the
+    #: per-rule cache key alongside the pass module's (mtime, size)
+    version = "1"
+
+    @classmethod
+    def cache_extra_inputs(cls, files) -> list:
+        """Extra files (beyond the analyzed ``.py`` set) whose content
+        determines this rule's findings — their (path, mtime, size)
+        triples join the rule's cache key. A pass that reads anything
+        off-tree (surface-parity's native extractor) MUST declare it
+        here, or a warm cache silently hides findings when only that
+        input changes."""
+        return []
 
     def __init__(self) -> None:
         self.index = None  # ProjectIndex, set by the driver via begin()

@@ -3,7 +3,9 @@ pass by dropping a module here and importing it below."""
 
 from tools.analyze.passes import (  # noqa: F401 — registration imports
     async_tasks,
+    atomic_snapshot,
     excepts,
+    guarded_field,
     hbm_budget,
     host_sync,
     jit_hygiene,
@@ -12,6 +14,7 @@ from tools.analyze.passes import (  # noqa: F401 — registration imports
     lock_order,
     log_hygiene,
     metric_hygiene,
+    surface_parity,
     swarm_policy,
     threads,
     wire_policy,

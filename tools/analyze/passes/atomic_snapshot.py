@@ -1,0 +1,229 @@
+"""atomic-snapshot: check-then-act / torn-read detection.
+
+The exact ``Telemetry.summary()`` bug (PR 9, finding 14): a method reads
+ring state under one acquisition of ``self._lock``, releases it, then
+re-acquires the SAME lock and combines state derived under the first
+hold with state read under the second — a concurrent writer between the
+holds makes the two halves describe different worlds, tearing the
+"snapshot" the method claims to produce.
+
+Model: every ``with <lock>:`` statement is a *region* of that lock, and
+every ``x = self.m(...)`` call whose resolved callee's ``acquires-lock``
+summary (through the call graph, bounded) contains a lock is a region of
+that lock too (the hold happens inside the callee on the method's
+behalf). A def-use edge that CROSSES region boundaries of one lock —
+a name assigned inside region 1, not reassigned in between, consumed
+inside a later region 2 of the same lock, in the same function — is the
+finding; blame carries both holds.
+
+Limits (documented in the README): the dataflow is name-based — state
+carried between holds through ``self`` attributes or container mutation
+is not tracked; call regions are recognized for ``self.<method>()``
+receivers only (one instance, one lock identity — cross-object calls
+would need alias facts the index deliberately does not speculate
+about); a region re-entered inside itself (``with L: … with L:``) is
+the lock-order pass's self-cycle, not a snapshot tear.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterator
+
+from tools.analyze.core import (
+    Finding,
+    ModuleContext,
+    Pass,
+    enclosing_class,
+    register,
+    walk_in_scope,
+)
+from tools.analyze.index import lock_id
+
+
+@dataclass
+class _Region:
+    lock: str
+    node: ast.AST
+    line: int
+    end_line: int
+    defs: set
+    uses: set
+    kind: str  # "with" | "call"
+
+
+def _names(node: ast.AST, ctx_type) -> set[str]:
+    out: set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ctx_type):
+            out.add(sub.id)
+    return out
+
+
+def _live_uses(node: ast.AST) -> set[str]:
+    """Names LOADED in ``node`` whose first load precedes any store to
+    the same name inside ``node`` — a region that rewrites a name before
+    reading it (double-checked locking's re-read) consumes its OWN
+    value, not state carried from an earlier hold."""
+    first_load: dict[str, tuple[int, int]] = {}
+    first_store: dict[str, tuple[int, int]] = {}
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Name):
+            continue
+        key = (sub.lineno, sub.col_offset)
+        book = first_load if isinstance(sub.ctx, ast.Load) else first_store
+        if sub.id not in book or key < book[sub.id]:
+            book[sub.id] = key
+    return {
+        n for n, at in first_load.items()
+        if n not in first_store or at <= first_store[n]
+    }
+
+
+@register
+class AtomicSnapshotPass(Pass):
+    id = "atomic-snapshot"
+    version = "1"
+    description = (
+        "one logical operation split across two acquisitions of the same "
+        "lock with state carried between the holds (check-then-act / "
+        "torn snapshot — a concurrent writer between the holds makes the "
+        "two halves disagree)"
+    )
+
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            regions = self._regions(ctx, node)
+            if len(regions) < 2:
+                continue
+            yield from self._pair_up(ctx, node, regions)
+
+    # ------------------------------------------------------ region scan
+    def _regions(self, ctx: ModuleContext,
+                 fn: ast.AST) -> list[_Region]:
+        idx = self.index
+        aliases = idx.aliases.get(ctx.module) if idx is not None else None
+        out: list[_Region] = []
+        for sub in walk_in_scope(fn):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                cls = enclosing_class(sub)
+                for item in sub.items:
+                    lid = lock_id(ctx, item.context_expr, cls, fn, aliases)
+                    if lid is None:
+                        continue
+                    out.append(_Region(
+                        lock=lid, node=sub, line=sub.lineno,
+                        end_line=sub.end_lineno or sub.lineno,
+                        defs=_names(sub, ast.Store),
+                        uses=_live_uses(sub), kind="with"))
+            elif isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call):
+                out.extend(self._call_region(
+                    ctx, sub, sub.value, {sub.targets[0].id}))
+            elif isinstance(sub, (ast.Expr, ast.Return)) \
+                    and isinstance(sub.value, ast.Call):
+                out.extend(self._call_region(ctx, sub, sub.value, set()))
+        return out
+
+    def _call_region(self, ctx: ModuleContext, stmt: ast.stmt,
+                     call: ast.Call, defs: set) -> list[_Region]:
+        """Regions for ``self.m(...)`` calls whose callee acquires locks
+        (the hold happens on this method's behalf)."""
+        idx = self.index
+        if idx is None:
+            return []
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self"):
+            return []
+        q = idx.resolve_in(ctx.rel, call)
+        if q is None:
+            return []
+        locks = idx.acquired_locks(q)
+        if not locks:
+            return []
+        uses = _names(call, ast.Load) - {"self"}
+        return [_Region(lock=lid, node=stmt, line=stmt.lineno,
+                        end_line=stmt.end_lineno or stmt.lineno,
+                        defs=set(defs), uses=uses, kind="call")
+                for lid in sorted(locks)]
+
+    # --------------------------------------------------------- pairing
+    def _pair_up(self, ctx: ModuleContext, fn: ast.AST,
+                 regions: list[_Region]) -> Iterator[Finding]:
+        stores_by_name: dict[str, list[int]] = {}
+        for sub in walk_in_scope(fn):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                stores_by_name.setdefault(sub.id, []).append(sub.lineno)
+        reported: set[tuple[int, int]] = set()
+        for i, r1 in enumerate(regions):
+            for r2 in regions:
+                if r2 is r1 or r2.lock != r1.lock:
+                    continue
+                # strictly sequential, not nested (an ancestor's span
+                # contains the descendant's)
+                if not (r1.end_line < r2.line):
+                    continue
+                if self._is_ancestor(r1.node, r2.node) \
+                        or self._is_ancestor(r2.node, r1.node):
+                    continue
+                # data flow into the second hold, or CONTROL flow: a
+                # guard condition evaluated after the first hold that
+                # decides whether the second hold runs (check-then-act)
+                guard_uses = self._guard_names(fn, r2, r1.end_line)
+                flow = {
+                    n for n in (r1.defs & r2.uses)
+                    if not any(r1.end_line < ln < r2.line
+                               for ln in stores_by_name.get(n, ()))
+                }
+                # a guard whose name the second hold RE-DERIVES is
+                # double-checked locking — the re-validation under the
+                # second hold is exactly the fix, not the bug
+                guard_flow = {
+                    n for n in (r1.defs & guard_uses)
+                    if not any(r1.end_line < ln < r2.line
+                               for ln in stores_by_name.get(n, ()))
+                } - flow - r2.defs
+                if (not flow and not guard_flow) \
+                        or (r1.line, r2.line) in reported:
+                    continue
+                reported.add((r1.line, r2.line))
+                names = ", ".join(sorted(flow | guard_flow))
+                how = ("is consumed under" if flow
+                       else "gates whether this code runs under")
+                yield Finding(
+                    ctx.rel, r2.line, self.id,
+                    f"'{names}' derived under a hold of {r1.lock} at "
+                    f"line {r1.line} {how} a SECOND hold of "
+                    "the same lock here — the two holds are not atomic; "
+                    "a concurrent writer between them tears the snapshot "
+                    "(take one copy under one hold, or merge/re-validate "
+                    "under the second)",
+                )
+
+    @staticmethod
+    def _guard_names(fn: ast.AST, r2: _Region, after_line: int) -> set:
+        """Loaded names in the tests of If/While statements enclosing
+        ``r2`` that are evaluated AFTER line ``after_line`` — the
+        check-then-act guard path into the second hold."""
+        out: set = set()
+        cur = getattr(r2.node, "_dm_parent", None)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.If, ast.While)) \
+                    and cur.lineno > after_line:
+                out |= _names(cur.test, ast.Load)
+            cur = getattr(cur, "_dm_parent", None)
+        return out
+
+    @staticmethod
+    def _is_ancestor(a: ast.AST, b: ast.AST) -> bool:
+        cur = getattr(b, "_dm_parent", None)
+        while cur is not None:
+            if cur is a:
+                return True
+            cur = getattr(cur, "_dm_parent", None)
+        return False

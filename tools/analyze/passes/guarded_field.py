@@ -1,0 +1,460 @@
+"""guarded-field: RacerD-style lock-set race detection over ProjectIndex.
+
+The tree is heavily multithreaded (reactor handoffs, bounded pools, the
+tuner tick thread, swarm fill workers, telemetry samplers), and the bug
+class that keeps surfacing in manual review is always the same shape: a
+field written on a worker thread under one lock (or none) and read from
+another thread under a different lock (or none). This pass proves the
+absence of that shape compositionally, Infer/RacerD-style:
+
+1. **Access summaries** — for every method of every class, each
+   ``self.<attr>`` read/write site is recorded with the lock set held
+   lexically at the site (``with self._lock:`` regions, identities
+   normalized through :func:`tools.analyze.index.lock_id` plus per-class
+   attribute aliasing, so ``self._mu = self._lock`` makes ``with
+   self._mu:`` and ``with self._lock:`` the same lock).
+2. **Caller-lock composition** — a lock the *caller* must hold at every
+   resolved call site of a method protects the method's accesses too:
+   the effective lock set at a site is its lexical set ∪ the
+   INTERSECTION of locks held across all call sites of the enclosing
+   method (must-hold, bounded depth through the call graph — the
+   existing ``acquires-lock`` summaries feed the per-site held sets).
+3. **Concurrency evidence** — a method is *worker-escaping* when any
+   ``FunctionInfo.submit_calls`` edge anywhere in the run (``ex.submit``
+   / ``Thread(target=…)`` / ``asyncio.to_thread``, any module) resolves
+   to it, or when it is call-graph-reachable from such a method. No
+   evidence → no findings for the class (no-speculative-edges: a class
+   nothing submits is not assumed concurrent).
+4. **Race check** — per field: a WRITE site and any other access site,
+   at least one of them on a worker-escaping path, with DISJOINT
+   effective lock sets, is a race finding; the blame names both sites
+   and the submit edge that makes them concurrent.
+
+Ownership filters (the RacerD "owned before shared" discipline):
+``__init__`` accesses never participate and a field written ONLY in
+``__init__`` is immutable-after-construction; lock-shaped attributes
+and bound-method references are not data fields.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from tools.analyze.core import (
+    Finding,
+    ModuleContext,
+    Pass,
+    enclosing_class,
+    enclosing_function,
+    register,
+    walk_in_scope,
+)
+from tools.analyze.index import LOCKISH_RE, lock_id
+
+
+#: receiver methods that mutate the container they are called on — a
+#: ``self.ring.append(x)`` is a WRITE to the field's contents even though
+#: the attribute node itself is a Load (dict/list mutation from two
+#: threads is exactly the statusz attrs-dict bug class)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "popitem", "clear", "update", "setdefault", "add", "discard",
+    "sort", "reverse",
+})
+
+
+#: constructors whose result is a known mutable container — only fields
+#: bound to one of these ever count a ``.append()``-style call as a
+#: write (``self.store.remove(key)`` on a domain object is that object's
+#: API, and its internal locking is its own rule surface)
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter"}
+
+
+def container_attrs(cls_node: ast.ClassDef) -> set[str]:
+    """Attributes this class binds to a container literal/constructor in
+    any of its methods (``self.ring = []``, ``self._peers = dict()``)."""
+    out: set[str] = set()
+    for sub in ast.walk(cls_node):
+        if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Attribute)
+                and isinstance(sub.targets[0].value, ast.Name)
+                and sub.targets[0].value.id == "self"):
+            continue
+        v = sub.value
+        if isinstance(v, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+            out.add(sub.targets[0].attr)
+        elif isinstance(v, ast.Call):
+            f = v.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name in _CONTAINER_CTORS:
+                out.add(sub.targets[0].attr)
+    return out
+
+
+def _is_write(sub: ast.Attribute, containers: set[str]) -> bool:
+    """Store/Del/AugAssign target, subscript store (``self.d[k] = v``),
+    or a mutating container method (``self.ring.append(x)``) on a field
+    the class binds to a container."""
+    if isinstance(sub.ctx, (ast.Store, ast.Del)):
+        return True
+    parent = getattr(sub, "_dm_parent", None)
+    if isinstance(parent, ast.AugAssign):
+        return True
+    if isinstance(parent, ast.Subscript) and parent.value is sub \
+            and isinstance(parent.ctx, (ast.Store, ast.Del)):
+        return True
+    if sub.attr in containers and isinstance(parent, ast.Attribute) \
+            and parent.value is sub and parent.attr in _MUTATORS:
+        grand = getattr(parent, "_dm_parent", None)
+        if isinstance(grand, ast.Call) and grand.func is parent:
+            return True
+    return False
+
+
+def _in_loop(node: ast.AST) -> bool:
+    cur = getattr(node, "_dm_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        cur = getattr(cur, "_dm_parent", None)
+    return False
+
+
+@dataclass(frozen=True)
+class Access:
+    cls: str            # owning class qname
+    attr: str
+    write: bool
+    rel: str
+    line: int
+    locks: frozenset
+    method: str         # enclosing method qname
+
+
+@dataclass
+class _MethodFacts:
+    accesses: list = field(default_factory=list)     # [Access]
+    #: resolved outgoing call sites: [(callee qname, lexical locks held)]
+    calls: list = field(default_factory=list)
+
+
+def _held_locks(node: ast.AST, ctx: ModuleContext, fn: ast.AST,
+                aliases: dict | None,
+                cls_lock_attrs: set[str] | None = None) -> set[str]:
+    """Lock ids of every ``with``-statement enclosing ``node`` inside
+    ``fn``. A node inside a ``withitem`` (the lock expression being
+    acquired) does not count that With as held. ``cls_lock_attrs`` are
+    extra ``self.<attr>`` names known to BE locks for the enclosing
+    class even when not lock-named — ``self._cv = threading.Condition(
+    self._lock)`` makes ``with self._cv:`` hold the underlying lock."""
+    held: set[str] = set()
+    prev = node
+    cur = getattr(node, "_dm_parent", None)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.With, ast.AsyncWith)) \
+                and not isinstance(prev, ast.withitem):
+            cls = enclosing_class(cur)
+            efn = enclosing_function(cur)
+            for item in cur.items:
+                expr = item.context_expr
+                lid = lock_id(ctx, expr, cls, efn, aliases)
+                if lid is None and cls_lock_attrs \
+                        and isinstance(expr, ast.Attribute) \
+                        and isinstance(expr.value, ast.Name) \
+                        and expr.value.id == "self" \
+                        and expr.attr in cls_lock_attrs \
+                        and cls is not None:
+                    lid = f"{ctx.module}.{cls.name}.{expr.attr}"
+                if lid is not None:
+                    held.add(lid)
+        prev, cur = cur, getattr(cur, "_dm_parent", None)
+    return held
+
+
+@register
+class GuardedFieldPass(Pass):
+    id = "guarded-field"
+    version = "1"
+    description = (
+        "RacerD-style lock-set analysis: a field written on a "
+        "worker-escaping path (ex.submit/Thread(target)) and accessed "
+        "elsewhere with a disjoint lock set is a data race — both sites "
+        "and the submit edge land in the blame"
+    )
+
+    #: caller-lock / reachability composition bound (matches the index's
+    #: summary-depth discipline)
+    MAX_DEPTH = 4
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._facts: dict[str, _MethodFacts] = {}      # method qname →
+        self._lock_alias: dict[str, dict[str, str]] = {}  # class → a→b
+
+    # ------------------------------------------------------------ visit
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        idx = self.index
+        if idx is None:
+            return iter(())
+        aliases = idx.aliases.get(ctx.module)
+        # per-class lock-attribute aliasing: ``self._mu = self._lock``
+        # (direct alias) or ``self._cv = threading.Condition(self._lock)``
+        # (a Condition ACQUIRES its underlying lock on __enter__) makes
+        # the two names one lock identity — the aliased-attribute case
+        # the lock-set intersection must see through
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == "self"):
+                continue
+            src_attr: str | None = None
+            v = node.value
+            if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self" and LOCKISH_RE.search(v.attr):
+                src_attr = v.attr
+            elif isinstance(v, ast.Call) and v.args:
+                fname = v.func.attr if isinstance(v.func, ast.Attribute) \
+                    else (v.func.id if isinstance(v.func, ast.Name) else "")
+                a0 = v.args[0]
+                if fname == "Condition" and isinstance(a0, ast.Attribute) \
+                        and isinstance(a0.value, ast.Name) \
+                        and a0.value.id == "self" \
+                        and LOCKISH_RE.search(a0.attr):
+                    src_attr = a0.attr
+            if src_attr is None:
+                continue
+            cls = enclosing_class(node)
+            if cls is None:
+                continue
+            cq = idx._qname_of(ctx, cls)[0]
+            self._lock_alias.setdefault(cq, {})[
+                node.targets[0].attr] = src_attr
+
+        containers: dict[str, set[str]] = {}
+        for info in idx.functions.values():
+            if info.rel != ctx.rel or info.cls is None:
+                continue
+            facts = self._facts.setdefault(info.qname, _MethodFacts())
+            methods = idx.classes.get(info.cls, {})
+            lock_attrs = set(self._lock_alias.get(info.cls, {}))
+            if info.cls not in containers:
+                cls_node = enclosing_class(info.node)
+                containers[info.cls] = (container_attrs(cls_node)
+                                        if cls_node is not None else set())
+            for sub in walk_in_scope(info.node):
+                if isinstance(sub, ast.Attribute) \
+                        and isinstance(sub.value, ast.Name) \
+                        and sub.value.id == "self":
+                    attr = sub.attr
+                    if attr in methods or LOCKISH_RE.search(attr) \
+                            or attr in lock_attrs:
+                        continue  # bound methods / sync objects
+                    if info.name == "__init__":
+                        continue  # owned before shared
+                    write = _is_write(sub, containers[info.cls])
+                    held = self._canon_locks(
+                        _held_locks(sub, ctx, info.node, aliases,
+                                    lock_attrs), info.cls)
+                    facts.accesses.append(Access(
+                        cls=info.cls, attr=attr, write=write, rel=ctx.rel,
+                        line=sub.lineno, locks=frozenset(held),
+                        method=info.qname))
+                elif isinstance(sub, ast.Call):
+                    q = idx.resolve_in(ctx.rel, sub)
+                    if q is not None and q != info.qname:
+                        held = self._canon_locks(
+                            _held_locks(sub, ctx, info.node, aliases,
+                                        lock_attrs),
+                            info.cls)
+                        facts.calls.append((q, frozenset(held)))
+        return iter(())
+
+    def _canon_locks(self, locks: set[str], cls: str | None) -> set[str]:
+        """Rewrite this class's aliased lock attrs to their root name so
+        intersecting-through-an-alias lock sets actually intersect."""
+        alias = self._lock_alias.get(cls or "", None)
+        if not alias:
+            return locks
+        out = set()
+        for lid in locks:
+            head, _, attr = lid.rpartition(".")
+            seen = set()
+            while attr in alias and attr not in seen:
+                seen.add(attr)
+                attr = alias[attr]
+            out.add(f"{head}.{attr}" if head else attr)
+        return out
+
+    # --------------------------------------------------------- finalize
+    def finalize(self) -> Iterator[Finding]:
+        idx = self.index
+        if idx is None:
+            return
+        # late alias canonicalization: visit order is arbitrary, so an
+        # alias collected AFTER a module's accesses must still apply
+        for q, facts in self._facts.items():
+            info = idx.functions.get(q)
+            cls = info.cls if info else None
+            facts.accesses = [
+                Access(a.cls, a.attr, a.write, a.rel, a.line,
+                       frozenset(self._canon_locks(set(a.locks), a.cls)),
+                       a.method)
+                for a in facts.accesses]
+            facts.calls = [(c, frozenset(self._canon_locks(set(h), cls)))
+                           for c, h in facts.calls]
+
+        # concurrency evidence: methods any submit edge resolves to
+        # (entries), closed over the call graph (bounded). Each entry
+        # remembers its submit site and whether MULTIPLE instances of
+        # that worker can exist (submitted inside a loop, or from two
+        # distinct sites) — two accesses reachable only from one
+        # single-instance entry run on ONE thread and never race.
+        entries: dict[str, list] = {}  # entry → [rel, line, multi]
+        for info in idx.functions.values():
+            for q, _raw, node in info.submit_calls:
+                if q not in idx.functions:
+                    continue
+                multi = _in_loop(node)
+                prev = entries.get(q)
+                if prev is None:
+                    entries[q] = [info.rel, node.lineno, multi]
+                else:
+                    prev[2] = True  # second submit site → multi-instance
+        #: method qname → set of entry qnames it can run under
+        roots: dict[str, set[str]] = {q: {q} for q in entries}
+        frontier = list(entries)
+        for _ in range(self.MAX_DEPTH):
+            nxt = []
+            for q in frontier:
+                for callee, _h in self._facts.get(q, _MethodFacts()).calls:
+                    tgt = roots.setdefault(callee, set())
+                    before = len(tgt)
+                    tgt |= roots[q]
+                    if len(tgt) != before:
+                        nxt.append(callee)
+            frontier = nxt
+        worker_set = {q for q, r in roots.items() if r}
+        # main-capability: a method OUTSIDE the worker closure runs on
+        # the spawning side; a method inside it is also main-capable
+        # when some caller outside the closure reaches it
+        main_capable: set[str] = set()
+        for q in self._facts:
+            if q not in worker_set:
+                main_capable.add(q)
+        for q, facts in self._facts.items():
+            if q in main_capable:
+                for callee, _h in facts.calls:
+                    if callee in worker_set:
+                        main_capable.add(callee)
+
+        # caller-lock must-hold sets (intersection over all call sites)
+        callers: dict[str, list] = {}
+        for q, facts in self._facts.items():
+            for callee, held in facts.calls:
+                callers.setdefault(callee, []).append((q, held))
+        memo: dict[str, frozenset] = {}
+
+        def must_hold(q: str, depth: int) -> frozenset:
+            if q in memo:
+                return memo[q]
+            memo[q] = frozenset()  # cycle guard: assume nothing held
+            sites = callers.get(q)
+            out: frozenset | None = None
+            if sites and depth > 0:
+                for caller_q, held in sites:
+                    eff = held | must_hold(caller_q, depth - 1)
+                    out = eff if out is None else (out & eff)
+            memo[q] = out or frozenset()
+            return memo[q]
+
+        # group effective access sites per (class, field)
+        fields: dict[tuple[str, str], list[Access]] = {}
+        for q, facts in self._facts.items():
+            extra = must_hold(q, self.MAX_DEPTH)
+            for a in facts.accesses:
+                eff = a if not extra else Access(
+                    a.cls, a.attr, a.write, a.rel, a.line,
+                    a.locks | extra, a.method)
+                fields.setdefault((a.cls, a.attr), []).append(eff)
+
+        reported: set[tuple[str, str]] = set()
+        for (cls, attr), sites in sorted(fields.items()):
+            writes = [s for s in sites if s.write]
+            if not writes:
+                continue  # immutable after __init__ (init sites excluded)
+            pair = self._racing_pair(writes, sites, roots, main_capable,
+                                     entries)
+            if pair is None or (cls, attr) in reported:
+                continue
+            reported.add((cls, attr))
+            w, other, (sub_rel, sub_line) = pair
+            wl = self._fmt(w.locks)
+            ol = self._fmt(other.locks)
+            kind = "written" if other.write else "read"
+            yield Finding(
+                w.rel, w.line, self.id,
+                f"field '{attr}' of {cls} written here under {wl} and "
+                f"{kind} at {other.rel}:{other.line} under {ol} — lock "
+                "sets are disjoint and the method escapes to a worker "
+                f"(submitted at {sub_rel}:{sub_line}); a concurrent "
+                "interleaving tears this field",
+            )
+
+    def _racing_pair(self, writes, sites, roots, main_capable, entries):
+        """First (write, other-access, submit-site) with disjoint locks
+        that can execute on two DIFFERENT threads: distinct worker
+        entries, worker vs main, or one multi-instance worker entry."""
+        for w in sorted(writes, key=lambda s: (s.rel, s.line)):
+            wr = roots.get(w.method, set())
+            wm = w.method in main_capable
+            for a in sorted(sites, key=lambda s: (s.rel, s.line)):
+                same_site = (a.rel, a.line) == (w.rel, w.line)
+                ar = roots.get(a.method, set())
+                am = a.method in main_capable
+                if not wr and not ar:
+                    continue  # no worker evidence on either side
+                if same_site:
+                    # one site racing ITSELF needs two live instances of
+                    # its worker (submitted in a loop / from two sites)
+                    multi = [e for e in sorted(wr)
+                             if entries[e][2]]
+                    if not multi:
+                        continue
+                    evidence = tuple(entries[multi[0]][:2])
+                else:
+                    evidence = self._concurrent(wr, wm, ar, am, entries)
+                if evidence is None:
+                    continue
+                if w.locks & a.locks:
+                    continue
+                return w, a, evidence
+        return None
+
+    @staticmethod
+    def _concurrent(wr, wm, ar, am, entries):
+        """Submit-site evidence that the two sides can overlap, or None.
+        Distinct roots overlap; one root overlaps itself only when its
+        entry is multi-instance; main overlaps any worker root."""
+        for e in sorted(wr):
+            rel, line, multi = entries[e]
+            if am or (ar - {e}) or (e in ar and multi):
+                return rel, line
+        for e in sorted(ar):
+            rel, line, multi = entries[e]
+            if wm or (wr - {e}) or (e in wr and multi):
+                return rel, line
+        return None
+
+    @staticmethod
+    def _fmt(locks: frozenset) -> str:
+        if not locks:
+            return "NO lock"
+        return "{" + ", ".join(sorted(locks)) + "}"

@@ -1,0 +1,417 @@
+"""surface-parity: the native and Python planes must resolve one logical
+knob/metric/rank identically.
+
+PR 9 found the native proxy resolving ``DEMODEL_TELEMETRY_MIN_MS``
+(default 128) while Python resolved ``DEMODEL_TELEMETRY_MIN_GAP_MS``
+(default 250) — two surfaces that claim to mirror each other silently
+diverging. This pass makes that drift a build-breaking finding, with a
+clang-free, regex-level extractor over ``native/*.{h,cc}``:
+
+- **env knobs** — ``env_pos_int("DEMODEL_…")`` / ``getenv("DEMODEL_…")``
+  sites plus the ``if (v == 0) v = <literal>;`` fallback idiom yield
+  (key, type, default); bool knobs come from the ``if (!v || !*v)
+  return true;`` idiom. Python-side: every ``env_int`` / ``env_bool`` /
+  ``env_float`` call with a literal ``"DEMODEL_…"`` key in the run.
+  Findings: a key BOTH sides resolve with different literal defaults or
+  different types; also two PYTHON sites resolving one key with
+  different literal defaults (same drift, one plane).
+- **metric families** — the keys of the native ``Metrics::json()``
+  format string, split into gauges (fields reassigned at scrape time in
+  ``Proxy::metrics_json()`` — point-in-time state) and counters, diffed
+  against ``utils/metrics.PROXY_GAUGES`` (what ``render`` types the
+  scrape with); plus the native-internal check that every
+  ``hist_json()`` family is windowed by ``kTelemetryFamilyNames``.
+- **lock ranks** — the ``constexpr int kRank… = N;`` table in
+  ``native/lock_order.h`` diffed against the Python mirror
+  ``demodel_tpu.native.NATIVE_LOCK_RANKS`` (name set and values), plus
+  duplicate-rank detection (two locks on one rank defeats the ordering).
+
+Scope/anchoring: the pass activates when the run contains the real
+tree's ``demodel_tpu/utils/env.py`` (native dir = ``<root>/native``) or
+a file carrying ``# demodel: parity-native=<dir>`` (golden fixtures
+point at a miniature fake native tree). Defaults that are not literal
+ints/bools on either side ("computed": core-count-derived pool sizes)
+are recorded but never compared — no speculative evaluation of C++.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator
+
+from tools.analyze.core import Finding, ModuleContext, Pass, register
+
+_PRAGMA_RE = re.compile(r"#\s*demodel:\s*parity-native=(\S+)")
+
+# ---- native-side extractor patterns ----------------------------------
+_RANK_RE = re.compile(r"constexpr\s+int\s+(kRank\w+)\s*=\s*(\d+)\s*;")
+_ENV_INT_RE = re.compile(r'env_pos_int\(\s*"(DEMODEL_\w+)"')
+_GETENV_RE = re.compile(r'getenv\(\s*"(DEMODEL_\w+)"\s*\)')
+_JSON_KEY_RE = re.compile(r'\\"(\w+)\\":%llu')
+_GAUGE_ASSIGN_RE = re.compile(r"metrics_\.(\w+)\s*=")
+_HIST_FAMILY_RE = re.compile(r'append_hist_family\(\s*&\w+,\s*"(\w+)"')
+_TEL_FAMILY_RE = re.compile(
+    r"kTelemetryFamilyNames\[\]\s*=\s*\{([^}]*)\}", re.DOTALL)
+_STR_RE = re.compile(r'"(\w+)"')
+
+_PY_ENV_FUNCS = {"env_int": "int", "env_bool": "bool", "env_float": "float"}
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.DOTALL)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def _body_of(text: str, signature_re: str) -> str:
+    """Source between a function signature and its column-0 closing
+    brace — regex-level scoping, good enough for the two bodies the
+    extractor needs."""
+    m = re.search(signature_re, text)
+    if not m:
+        return ""
+    end = text.find("\n}", m.end())
+    return text[m.end():end if end >= 0 else len(text)]
+
+
+class NativeSurface:
+    """Everything the extractor learned from one native tree."""
+
+    def __init__(self) -> None:
+        self.knobs: dict[str, tuple[str, object, str, int]] = {}
+        # key → (type, default | "computed", rel, line)
+        self.ranks: dict[str, tuple[int, str, int]] = {}
+        self.json_keys: list[str] = []
+        self.gauge_keys: set[str] = set()
+        self.hist_families: set[str] = set()
+        self.telemetry_families: set[str] = set()
+        self.files_seen = 0
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def extract_native(native_dir: Path, rel_prefix: str) -> NativeSurface:
+    out = NativeSurface()
+    for path in sorted(native_dir.glob("*.h")) + sorted(
+            native_dir.glob("*.cc")):
+        try:
+            raw = path.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            continue
+        out.files_seen += 1
+        rel = f"{rel_prefix}{path.name}"
+        text = _strip_comments(raw)
+
+        for m in _RANK_RE.finditer(text):
+            out.ranks[m.group(1)] = (int(m.group(2)), rel,
+                                     _line_of(text, m.start()))
+
+        # int knobs: env_pos_int("KEY" …) with the `if (v == 0) v = N;`
+        # fallback idiom supplying the effective default
+        statements = text.split(";")
+        for si, stmt in enumerate(statements):
+            m = _ENV_INT_RE.search(stmt)
+            if not m:
+                continue
+            key = m.group(1)
+            var_m = re.search(r"([A-Za-z_]\w*)\s*=[^=]", stmt)
+            default: object = "computed"
+            if var_m:
+                var = var_m.group(1)
+                fallback = re.compile(
+                    r"if\s*\(\s*%s\s*(?:==|<=)\s*0\s*\)\s*%s\s*=\s*(.+)"
+                    % (re.escape(var), re.escape(var)))
+                for nxt in statements[si + 1:si + 6]:
+                    fm = fallback.search(nxt)
+                    if fm:
+                        val = fm.group(1).strip()
+                        default = int(val) if re.fullmatch(r"\d+", val) \
+                            else "computed"
+                        break
+            pos = text.find(stmt)
+            at = (pos + m.start()) if pos >= 0 else 0
+            out.knobs.setdefault(
+                key, ("int", default, rel, _line_of(text, at)))
+
+        # bool knobs: getenv("KEY") + `if (!v || !*v) return true;`
+        for m in _GETENV_RE.finditer(text):
+            key = m.group(1)
+            if key in out.knobs:
+                continue
+            window = text[m.end():m.end() + 400]
+            bm = re.search(
+                r"if\s*\(\s*!v\s*\|\|\s*!\*v\s*\)\s*return\s+(true|false)",
+                window)
+            if bm:
+                out.knobs[key] = ("bool", bm.group(1) == "true", rel,
+                                  _line_of(text, m.start()))
+            else:
+                out.knobs.setdefault(
+                    key, ("str", "computed", rel, _line_of(text, m.start())))
+
+        body = _body_of(text, r"std::string\s+Metrics::json\s*\(")
+        if body:
+            out.json_keys = _JSON_KEY_RE.findall(body)
+        gbody = _body_of(text, r"std::string\s+Proxy::metrics_json\s*\(")
+        if gbody:
+            fields = set(_GAUGE_ASSIGN_RE.findall(gbody))
+            for f in fields:
+                for cand in (f, f + "_total"):
+                    if cand in out.json_keys:
+                        out.gauge_keys.add(cand)
+        out.hist_families |= set(_HIST_FAMILY_RE.findall(text))
+        tm = _TEL_FAMILY_RE.search(text)
+        if tm:
+            out.telemetry_families |= set(_STR_RE.findall(tm.group(1)))
+    return out
+
+
+@register
+class SurfaceParityPass(Pass):
+    id = "surface-parity"
+    version = "1"
+    description = (
+        "native↔Python surface drift: env knobs resolved with different "
+        "defaults/types per plane (or twice per plane), native metric "
+        "gauge/counter typing disagreeing with utils/metrics.PROXY_GAUGES, "
+        "hist families the telemetry window never serves, and "
+        "native/lock_order.h ranks diverging from the Python mirror"
+    )
+
+    @classmethod
+    def cache_extra_inputs(cls, files) -> list:
+        """The native sources this pass diffs against: their stat
+        triples join the per-rule cache key, so a rank/knob edit in
+        ``native/*.{h,cc}`` ALONE invalidates this rule's cached
+        findings (the analyzed ``.py`` set is unchanged in that case —
+        without this, a warm run silently blesses native drift).
+        Discovery mirrors the pass's own anchoring: the real tree via
+        ``demodel_tpu/utils/env.py`` → ``<root>/native``, fixtures via
+        the ``parity-native=`` pragma in the file's head."""
+        dirs: list[Path] = []
+        for p in files:
+            path = Path(p)
+            posix = path.as_posix()
+            if posix.endswith("demodel_tpu/utils/env.py"):
+                root = Path(posix[: -len("demodel_tpu/utils/env.py")]
+                            or ".")
+                dirs.append(root / "native")
+                continue
+            try:
+                head = path.read_text(encoding="utf-8",
+                                      errors="replace")[:4096]
+            except OSError:
+                continue
+            pm = _PRAGMA_RE.search(head)
+            if pm:
+                dirs.append(path.parent / pm.group(1))
+        out: list[Path] = []
+        for d in dirs:
+            if d.is_dir():
+                out.extend(sorted(d.glob("*.h")))
+                out.extend(sorted(d.glob("*.cc")))
+        return out
+
+    def __init__(self) -> None:
+        super().__init__()
+        #: key → list of (type, default | "computed", rel, line)
+        self._py_knobs: dict[str, list] = {}
+        self._proxy_gauges: tuple[set, str, int] | None = None
+        self._py_ranks: tuple[dict, str, int] | None = None
+        self._native_dirs: list[tuple[Path, str]] = []  # (dir, rel prefix)
+
+    # ------------------------------------------------------------ visit
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        pm = _PRAGMA_RE.search(ctx.source)
+        if pm:
+            self._native_dirs.append(
+                (Path(ctx.path).resolve().parent / pm.group(1),
+                 ctx.rel.rsplit("/", 1)[0] + "/" + pm.group(1) + "/"
+                 if "/" in ctx.rel else pm.group(1) + "/"))
+        elif ctx.rel == "demodel_tpu/utils/env.py":
+            # the real tree's anchor: <repo root>/native
+            root = Path(str(Path(ctx.path).resolve())[: -len(ctx.rel)]) \
+                if str(Path(ctx.path).resolve()).endswith(ctx.rel) \
+                else Path.cwd()
+            self._native_dirs.append((root / "native", "native/"))
+
+        in_scope = ctx.rel.startswith("demodel_tpu/") or pm is not None
+        if not in_scope:
+            return iter(())
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                fname = node.func.attr if isinstance(node.func,
+                                                     ast.Attribute) \
+                    else (node.func.id if isinstance(node.func, ast.Name)
+                          else None)
+                if fname in _PY_ENV_FUNCS and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and node.args[0].value.startswith("DEMODEL_"):
+                    key = node.args[0].value
+                    typ = _PY_ENV_FUNCS[fname]
+                    default: object = "computed"
+                    if len(node.args) > 1:
+                        d = node.args[1]
+                        if isinstance(d, ast.Constant) and isinstance(
+                                d.value, (int, float, bool)):
+                            default = d.value
+                    elif typ == "bool":
+                        default = False  # env_bool's own default
+                    self._py_knobs.setdefault(key, []).append(
+                        (typ, default, ctx.rel, node.lineno))
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt = node.targets[0].id
+                if tgt == "PROXY_GAUGES":
+                    names = {
+                        e.value for e in ast.walk(node.value)
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    }
+                    self._proxy_gauges = (names, ctx.rel, node.lineno)
+                elif tgt == "NATIVE_LOCK_RANKS":
+                    try:
+                        val = ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        val = None
+                    if isinstance(val, dict):
+                        self._py_ranks = (val, ctx.rel, node.lineno)
+        return iter(())
+
+    # --------------------------------------------------------- finalize
+    def finalize(self) -> Iterator[Finding]:
+        # Python-internal default drift: one key, two literal defaults
+        for key, sites in sorted(self._py_knobs.items()):
+            lits = [(t, d, rel, line) for t, d, rel, line in sites
+                    if d != "computed"]
+            seen: dict[object, tuple] = {}
+            for t, d, rel, line in lits:
+                for prev_d, prev in seen.items():
+                    if d != prev_d:
+                        yield Finding(
+                            rel, line, self.id,
+                            f"{key} resolved with default {d!r} here but "
+                            f"{prev_d!r} at {prev[2]}:{prev[3]} — one "
+                            "logical knob, two Python defaults; move the "
+                            "default into a shared resolver",
+                        )
+                seen.setdefault(d, (t, d, rel, line))
+
+        for native_dir, prefix in self._native_dirs:
+            if not native_dir.is_dir():
+                continue
+            surf = extract_native(native_dir, prefix)
+            if not surf.files_seen:
+                continue
+            yield from self._diff_knobs(surf)
+            yield from self._diff_metrics(surf)
+            yield from self._diff_ranks(surf)
+
+    def _diff_knobs(self, surf: NativeSurface) -> Iterator[Finding]:
+        for key, (ntyp, ndef, nrel, nline) in sorted(surf.knobs.items()):
+            sites = self._py_knobs.get(key)
+            if not sites:
+                continue  # native-only knob: nothing claims to mirror it
+            for ptyp, pdef, prel, pline in sites:
+                if ntyp != "str" and ptyp != ntyp \
+                        and {ptyp, ntyp} != {"int", "float"}:
+                    yield Finding(
+                        prel, pline, self.id,
+                        f"{key} is typed {ptyp} here but {ntyp} on the "
+                        f"native side ({nrel}:{nline}) — one logical "
+                        "knob must parse identically on both planes",
+                    )
+                    continue
+                if pdef == "computed" or ndef == "computed":
+                    continue
+                if pdef != ndef:
+                    yield Finding(
+                        prel, pline, self.id,
+                        f"{key} defaults to {pdef!r} here but {ndef!r} "
+                        f"on the native side ({nrel}:{nline}) — the two "
+                        "surfaces mirror each other and must resolve "
+                        "one default",
+                    )
+
+    def _diff_metrics(self, surf: NativeSurface) -> Iterator[Finding]:
+        if self._proxy_gauges is not None and surf.json_keys:
+            names, rel, line = self._proxy_gauges
+            native_gauges = surf.gauge_keys
+            native_keys = set(surf.json_keys)
+            for extra in sorted(names - native_gauges):
+                why = ("a COUNTER there" if extra in native_keys
+                       else "absent from the native scrape")
+                yield Finding(
+                    rel, line, self.id,
+                    f"PROXY_GAUGES names '{extra}' as a native gauge but "
+                    f"it is {why} — render() would type the family "
+                    "wrong",
+                )
+            for missing in sorted(native_gauges - names):
+                yield Finding(
+                    rel, line, self.id,
+                    f"native metric '{missing}' is scrape-time pool state "
+                    "(a gauge) but PROXY_GAUGES omits it — render() "
+                    "types it counter and Prometheus rate() over it "
+                    "is garbage",
+                )
+        if surf.hist_families and surf.telemetry_families:
+            for fam in sorted(surf.hist_families
+                              - surf.telemetry_families):
+                rel, line = self._hist_anchor(surf)
+                yield Finding(
+                    rel, line, self.id,
+                    f"native hist family '{fam}' is exported by "
+                    "hist_json() but missing from kTelemetryFamilyNames "
+                    "— /debug/telemetry never windows it",
+                )
+
+    @staticmethod
+    def _hist_anchor(surf: NativeSurface) -> tuple[str, int]:
+        # anchor native-internal findings on any rank-bearing file's
+        # sibling .cc — fall back to the first knob site
+        for key, (_t, _d, rel, line) in surf.knobs.items():
+            return rel, line
+        return "native", 1
+
+    def _diff_ranks(self, surf: NativeSurface) -> Iterator[Finding]:
+        if self._py_ranks is None or not surf.ranks:
+            return
+        mirror, rel, line = self._py_ranks
+        by_rank: dict[int, str] = {}
+        for name, (value, nrel, nline) in sorted(surf.ranks.items()):
+            dup = by_rank.get(value)
+            if dup is not None:
+                yield Finding(
+                    nrel, nline, self.id,
+                    f"{name} and {dup} share rank {value} — equal ranks "
+                    "defeat the ordered-mutex check (neither can be "
+                    "acquired under the other)",
+                )
+            by_rank[value] = name
+            if name not in mirror:
+                yield Finding(
+                    rel, line, self.id,
+                    f"native lock rank {name}={value} ({nrel}:{nline}) "
+                    "is missing from NATIVE_LOCK_RANKS — the Python "
+                    "mirror no longer describes the real hierarchy",
+                )
+            elif mirror[name] != value:
+                yield Finding(
+                    rel, line, self.id,
+                    f"NATIVE_LOCK_RANKS[{name!r}] = {mirror[name]} but "
+                    f"the native table says {value} ({nrel}:{nline}) — "
+                    "rank drift makes the documented hierarchy a lie",
+                )
+        for name in sorted(set(mirror) - set(surf.ranks)):
+            yield Finding(
+                rel, line, self.id,
+                f"NATIVE_LOCK_RANKS names {name!r} but no such "
+                "constexpr rank exists in the native table — stale "
+                "mirror entry",
+            )
