@@ -494,6 +494,46 @@ def _check_regression(out: dict) -> dict:
     return out
 
 
+def _archive_history_check(out: dict) -> None:
+    """Post-run proof for the degraded leg: the pull's own telemetry
+    survived into the on-disk archive and comes back over the restore
+    server's ``/debug/telemetry/history`` endpoint — the retention-plane
+    datapoint rides the bench line instead of needing its own driver."""
+    import http.client
+
+    if not os.environ.get("DEMODEL_TELEMETRY_ARCHIVE"):
+        return
+    try:
+        from demodel_tpu.utils import retention
+
+        archive = retention.ensure()
+        if archive is not None:
+            archive.flush_once()  # the windows the flusher hasn't reached
+        from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+        from demodel_tpu.store import Store
+
+        with tempfile.TemporaryDirectory() as td:
+            with RestoreServer(RestoreRegistry(Store(Path(td) / "s")),
+                               host="127.0.0.1") as srv:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=30)
+                try:
+                    conn.request(
+                        "GET",
+                        "/debug/telemetry/history?family=pull_bytes_total",
+                        headers={"Connection": "close"})
+                    doc = json.loads(conn.getresponse().read())
+                finally:
+                    conn.close()
+        pts = doc.get("series", {}).get("pull_bytes_total", [])
+        out["telemetry_history_points"] = len(pts)
+        if not pts:
+            out["telemetry_history_error"] = \
+                "history endpoint returned no pull_bytes_total series"
+    except Exception as e:  # noqa: BLE001 — the check must not kill the leg
+        out["telemetry_history_error"] = str(e)
+
+
 def _run_guarded(kind: str, timeout: int) -> dict | None:
     """Run one bench leg in a subprocess with a hard timeout.
 
@@ -530,10 +570,20 @@ def main():
         # round must never masquerade as (or anchor against) the real
         # device-side series.
         os.environ["DEMODEL_BENCH_CPU"] = "1"
+        # the degraded leg doubles as the retention-plane datapoint: the
+        # pull runs with the archive on, and the history endpoint must
+        # hand the pull's own series back after the fact
+        os.environ.setdefault(
+            "DEMODEL_TELEMETRY_ARCHIVE",
+            str(Path(tempfile.mkdtemp(prefix="bench-telarch-"))))
+        from demodel_tpu.utils import retention
+
+        retention.ensure()
         out = _bench_e2e()
         out["metric"] = "cold_pull_to_host_ram_throughput"
         out["degraded_reason"] = "device_unreachable"
         out["projected_13gb_s"] = None  # projection is a device-side claim
+        _archive_history_check(out)
         print(json.dumps(out))
         return
     if "--fallback-child" in sys.argv:

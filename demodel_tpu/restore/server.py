@@ -462,6 +462,44 @@ def make_handler(registry: RestoreRegistry, proxy=None):
                 body = metrics.render(proxy=proxy, store=registry.store).encode()
                 self._send(200, body, ctype="text/plain; version=0.0.4")
                 return
+            if self.path.startswith("/debug/telemetry/history"):
+                # the durable tier: per-family series reconstructed from
+                # the on-disk archive, spanning restarts. Same dep-light
+                # stance as the swarm board: an archive can only exist if
+                # retention was started (DEMODEL_TELEMETRY_ARCHIVE), so
+                # peek sys.modules instead of importing the module
+                import sys as _sys
+                from urllib.parse import parse_qs, urlsplit
+
+                retention = _sys.modules.get("demodel_tpu.utils.retention")
+                archive = retention.current() if retention is not None \
+                    else None
+                if archive is None:
+                    self._send(404, b'{"error":"no telemetry archive '
+                                    b'(set DEMODEL_TELEMETRY_ARCHIVE)"}')
+                    return
+                q = parse_qs(urlsplit(self.path).query)
+
+                def _qs(key):
+                    v = q.get(key, [None])[0]
+                    return v if v else None
+
+                def _qf(key):
+                    v = _qs(key)
+                    try:
+                        return float(v) if v is not None else None
+                    except ValueError:
+                        return None
+
+                # pick up windows the background flusher hasn't reached
+                # yet, so history is current up to this very poll
+                archive.flush_once()
+                doc = archive.history(  # demodel: allow(metric-hygiene) — the family comes from the query string; an unknown family is an empty (not wrong) series, which is this endpoint's contract
+                    family=_qs("family"), label=_qs("label"),
+                    since=_qf("since"), until=_qf("until"))
+                doc["server"] = "restore"
+                self._send(200, json.dumps(doc, default=str).encode())
+                return
             if self.path == "/debug/telemetry":
                 # the time-series view: 30 s / 5 min sliding-window rates
                 # and delta-bucket quantiles over the Python hub, plus the
@@ -575,12 +613,22 @@ class RestoreServer:
     def __init__(self, registry: RestoreRegistry, host: str = "0.0.0.0",
                  port: int = 0, proxy=None):
         self.registry = registry
+        self._proxy = proxy
         self.httpd = ThreadingHTTPServer((host, port), make_handler(registry, proxy))
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
     def start(self) -> "RestoreServer":
         self._thread.start()
+        # durable telemetry rides the serving node: only when the archive
+        # knob is set does the retention module get imported/started at
+        # all — unset leaves this path byte-identical to a tree without it
+        from demodel_tpu.utils.env import telemetry_archive_dir
+
+        if telemetry_archive_dir():
+            from demodel_tpu.utils import retention
+
+            retention.ensure(proxy=self._proxy)
         log.info("restore API listening on :%d", self.port)
         return self
 

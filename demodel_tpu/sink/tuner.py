@@ -52,6 +52,12 @@ log = get_logger("sink.tuner")
 _WINDOW_READ = metrics.labeled("stage_duration_seconds", span="window-read")
 _BUDGET_WAIT = metrics.labeled("trace_span_seconds_total",
                                span="budget-wait")
+#: the device-plane signals (ROADMAP: "read device-side place/stage
+#: histograms"): how long placing a landed buffer onto the accelerator
+#: takes, end-to-end per sink delivery
+_PLACE = metrics.labeled("stage_duration_seconds", span="place")
+_SINK_DELIVER = metrics.labeled("stage_duration_seconds",
+                                span="sink-deliver")
 
 
 def tuner_enabled() -> bool:
@@ -145,6 +151,12 @@ class PullTuner:
 
         # AIMD state
         self.retry_hi = env_float("DEMODEL_TUNER_RETRY_HI", 0.25)  # /s
+        #: device-plane pressure thresholds: a windowed place/sink-deliver
+        #: p99 above place_hi seconds, or the ByteBudget charged past
+        #: hbm_hi of its cap, sheds prefetch — depth is the knob that
+        #: converts device-side latency/HBM pressure into admission relief
+        self.place_hi = env_float("DEMODEL_TUNER_PLACE_HI", 1.0)  # seconds
+        self.hbm_hi = env_float("DEMODEL_TUNER_HBM_HI", 0.85)  # share
         #: how long a live probe settles before being judged: the
         #: keep/revert test must read a window that POST-DATES the raise
         #: — judged one tick later against the window_s moving average,
@@ -281,7 +293,22 @@ class PullTuner:
         self._best_thr *= 0.5  # the old best is stale on a faulting link
         self._hold_until = self._clock() + 4 * self.tick_s
 
-    def _raise_one(self, thr: float) -> None:
+    def _budget_pressure(self) -> float:
+        """The live HBM/host-RAM admission pressure: the ByteBudget's
+        in-use share of its cap (0.0 without a budget — an unthrottled
+        pull has no device-side admission signal to read)."""
+        budget = self._budget
+        if budget is None:
+            return 0.0
+        try:
+            cap = float(budget.max_bytes)
+            if cap <= 0:
+                return 0.0
+            return float(budget.in_use) / cap
+        except Exception:  # noqa: BLE001 — a foreign budget shape
+            return 0.0
+
+    def _raise_one(self, thr: float, device_pressure: bool = False) -> None:
         """Additive increase: probe ONE knob upward, remember the
         pre-probe rate — the next tick keeps or reverts the raise."""
         candidates: list[tuple[str, int]] = []
@@ -298,7 +325,11 @@ class PullTuner:
                             > self.window_bytes)
             except Exception:  # noqa: BLE001 — a foreign budget shape
                 headroom = True
-        if self.prefetch_depth < self.max_prefetch and headroom:
+        # never probe prefetch upward while the device plane is the
+        # bottleneck — a deeper queue just converts place latency into
+        # pinned host RAM
+        if self.prefetch_depth < self.max_prefetch and headroom \
+                and not device_pressure:
             candidates.append(("prefetch_depth", self.prefetch_depth + 1))
         if not candidates:
             return
@@ -314,7 +345,9 @@ class PullTuner:
     def tick(self, *, thr: float | None = None,
              retry_rate: float | None = None,
              breaker_open: bool | None = None,
-             budget_wait_share: float | None = None) -> None:
+             budget_wait_share: float | None = None,
+             place_p99: float | None = None,
+             hbm_pressure: float | None = None) -> None:
         """One control decision. Signals default to the live telemetry
         plane; tests force them via keywords."""
         tel = self._tel()
@@ -334,10 +367,20 @@ class PullTuner:
             breaker_open = self._breaker_open()
         if budget_wait_share is None:
             budget_wait_share = tel.rate(_BUDGET_WAIT, self.window_s)
+        if place_p99 is None:
+            # device-side latency: whichever of the two device-plane
+            # stages is slower over the window is the pressure signal
+            place_p99 = max(
+                tel.window_quantile(_PLACE, 0.99, self.window_s),
+                tel.window_quantile(_SINK_DELIVER, 0.99, self.window_s))
+        if hbm_pressure is None:
+            hbm_pressure = self._budget_pressure()
         # the p99 the ROADMAP item names: read every tick so the signal
         # is on the tuner's span when a decision fires
         p99 = tel.window_quantile(_WINDOW_READ, 0.99, self.window_s)
         metrics.HUB.set_gauge("tuner_window_read_p99", p99)
+        metrics.HUB.set_gauge("tuner_place_p99", round(place_p99, 6))
+        metrics.HUB.set_gauge("tuner_hbm_pressure", round(hbm_pressure, 4))
         try:
             now = self._clock()
             # every knob/bookkeeping WRITE below happens under the knob
@@ -375,6 +418,21 @@ class PullTuner:
                         self._hold_until = now + 4 * self.tick_s
                         return
                 self._best_thr = max(self._best_thr, thr)
+                device_pressure = (place_p99 > self.place_hi
+                                   or hbm_pressure > self.hbm_hi)
+                if device_pressure and \
+                        self.prefetch_depth > max(1, self.min_prefetch):
+                    # device-bound: the accelerator (or the landing
+                    # budget feeding it) can't absorb what prefetch
+                    # already committed — trade depth for place latency
+                    new = self.prefetch_depth - 1
+                    reason = (f"place-p99 {place_p99:.2f}s"
+                              if place_p99 > self.place_hi
+                              else f"hbm-pressure {hbm_pressure:.2f}")
+                    self._decide("decrease", "prefetch_depth",
+                                 self.prefetch_depth, new, reason)
+                    self.prefetch_depth = new
+                    return
                 if budget_wait_share > 0.5 and \
                         self.prefetch_depth > max(1, self.min_prefetch):
                     # admission-bound: deeper prefetch pins more host RAM
@@ -384,7 +442,7 @@ class PullTuner:
                                  f"budget-wait share {budget_wait_share:.2f}")
                     self.prefetch_depth = new
                     return
-                self._raise_one(thr)
+                self._raise_one(thr, device_pressure=device_pressure)
         finally:
             # gauges reflect the POST-decision knob values — the scrape
             # and statusz must agree with what the fetch loop will use

@@ -120,3 +120,22 @@ def swarm_reap_enabled() -> bool:
     close() board behavior (e.g. a warm standby that WANTS to keep
     serving)."""
     return env_bool("DEMODEL_SWARM_REAP", True)
+
+
+def telemetry_archive_dir() -> str:
+    """``DEMODEL_TELEMETRY_ARCHIVE``: directory for the durable telemetry
+    archive (:mod:`demodel_tpu.utils.retention`). Empty/unset disables
+    the retention plane entirely — no import, no flusher thread."""
+    return os.environ.get("DEMODEL_TELEMETRY_ARCHIVE", "").strip()
+
+
+def telemetry_retain_mb() -> int:
+    """``DEMODEL_TELEMETRY_RETAIN_MB``: byte budget for archived
+    telemetry segments; oldest segments are evicted past it."""
+    return env_int("DEMODEL_TELEMETRY_RETAIN_MB", 64, minimum=1)
+
+
+def telemetry_retain_hours() -> int:
+    """``DEMODEL_TELEMETRY_RETAIN_HOURS``: age budget for archived
+    telemetry segments (default three days of history)."""
+    return env_int("DEMODEL_TELEMETRY_RETAIN_HOURS", 72, minimum=1)

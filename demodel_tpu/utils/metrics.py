@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import threading
 import time
 import weakref
@@ -157,21 +158,30 @@ class Hub:
                 self._telemetry = Telemetry(_hub_source(self))
             return self._telemetry
 
-    def rate(self, name: str, window_s: float = 30.0) -> float:
+    def rate(self, name: str, window_s: float = 30.0,
+             **labels: str | None) -> float:
         """Per-second increase of counter ``name`` over the trailing
-        window (0.0 until two snapshots exist)."""
-        return self.telemetry().rate(name, window_s)
+        window (0.0 until two snapshots exist). Label kwargs select one
+        labeled series: ``rate("peer_retries_total", peer=url)``."""
+        return self.telemetry().rate(name, window_s, **labels)
 
     def window_quantile(self, name: str, q: float,
-                        window_s: float = 30.0) -> float:
+                        window_s: float = 30.0,
+                        **labels: str | None) -> float:
         """Quantile of histogram ``name`` over ONLY the samples observed
         in the trailing window — the delta of the cumulative buckets
         between two ring snapshots, never the lifetime distribution."""
-        return self.telemetry().window_quantile(name, q, window_s)
+        return self.telemetry().window_quantile(name, q, window_s, **labels)
 
-    def series(self, name: str) -> list[dict[str, Any]]:
+    def series(self, name: str, **labels: str | None) -> list[dict[str, Any]]:
         """Per-snapshot dump of one family across the telemetry ring."""
-        return self.telemetry().series(name)
+        return self.telemetry().series(name, **labels)
+
+    def label_rates(self, base_name: str,
+                    window_s: float = 30.0) -> dict[str, float]:
+        """Per-series rates of one labeled family (full sample name →
+        rate) — :meth:`family_rate` without the aggregation."""
+        return self.telemetry().label_rates(base_name, window_s)
 
     def reset(self) -> None:  # tests only
         with self._lock:
@@ -194,6 +204,24 @@ def labeled(name: str, **labels: str | None) -> str:
                      .replace("\n", r"\n"))
         for k, v in sorted(labels.items()) if v is not None)
     return f"{name}{{{inner}}}" if inner else name
+
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_labels(name: str) -> tuple[str, dict[str, str]]:
+    """Inverse of :func:`labeled`: ``(base family, labels)`` for a sample
+    name — how consumers (the fleet per-peer table, the history reader)
+    attribute a labeled series back to its peer/span/route."""
+    base, brace, rest = name.partition("{")
+    if not brace:
+        return name, {}
+    labels = {
+        k: v.replace(r"\n", "\n").replace(r"\"", '"').replace(r"\\", "\\")
+        for k, v in _LABEL_RE.findall(rest)
+    }
+    return base, labels
+
 
 #: native proxy metrics that are point-in-time pool state, not monotonic
 #: counters — the session executor's live occupancy, queue depth, and the
@@ -370,6 +398,18 @@ class Telemetry:
         with self._lock:
             return len(self._ring)
 
+    def latest(self) -> dict[str, Any] | None:
+        """Copy of the newest ring snapshot (None when empty) — what the
+        retention archive's flusher diffs window-over-window."""
+        with self._lock:
+            if not self._ring:
+                return None
+            e = self._ring[-1]
+            return {"ts": e["ts"], "wall": e["wall"],
+                    "counters": dict(e["counters"]),
+                    "gauges": dict(e["gauges"]),
+                    "hists": dict(e["hists"])}
+
     # -- window selection ----------------------------------------------
     @staticmethod
     def _pair_in(ring: list[dict],
@@ -402,7 +442,10 @@ class Telemetry:
             old_v = 0.0  # counter reset (process restart): rate from zero
         return (now_v - old_v) / elapsed
 
-    def rate(self, name: str, window_s: float = 30.0) -> float:
+    def rate(self, name: str, window_s: float = 30.0,
+             **labels: str | None) -> float:
+        if labels:
+            name = labeled(name, **labels)
         self.freshen()
         pair = self._pair(window_s)
         if pair is None:
@@ -421,6 +464,26 @@ class Telemetry:
         return sum(self._rate_between(base, newest, name)
                    for name in newest["counters"]
                    if name == base_name or name.startswith(prefix))
+
+    def label_rates(self, base_name: str,
+                    window_s: float = 30.0) -> dict[str, float]:
+        """Per-series rates of one labeled family over the trailing
+        window: full sample name → rate, nonzero series only (the
+        unlabeled base series included when it exists). The per-peer
+        answer :meth:`family_rate`'s sum throws away."""
+        self.freshen()
+        pair = self._pair(window_s)
+        if pair is None:
+            return {}
+        base, newest = pair
+        prefix = base_name + "{"
+        out: dict[str, float] = {}
+        for name in sorted(newest["counters"]):
+            if name == base_name or name.startswith(prefix):
+                r = self._rate_between(base, newest, name)
+                if r:
+                    out[name] = round(r, 6)
+        return out
 
     @staticmethod
     def _delta_between(base: dict, newest: dict,
@@ -447,10 +510,12 @@ class Telemetry:
             "elapsed_s": newest["ts"] - base["ts"],
         }
 
-    def window_delta(self, name: str, window_s: float = 30.0
-                     ) -> dict[str, Any] | None:
+    def window_delta(self, name: str, window_s: float = 30.0,
+                     **labels: str | None) -> dict[str, Any] | None:
         """Histogram delta over the trailing window. None when no window
         exists or the family has no snapshots."""
+        if labels:
+            name = labeled(name, **labels)
         self.freshen()
         pair = self._pair(window_s)
         if pair is None:
@@ -458,15 +523,18 @@ class Telemetry:
         return self._delta_between(*pair, name)
 
     def window_quantile(self, name: str, q: float,
-                        window_s: float = 30.0) -> float:
-        d = self.window_delta(name, window_s)
+                        window_s: float = 30.0,
+                        **labels: str | None) -> float:
+        d = self.window_delta(name, window_s, **labels)
         if d is None or d["count"] <= 0:
             return 0.0
         return hist_quantile(d["le"], d["counts"], q)
 
-    def series(self, name: str) -> list[dict[str, Any]]:
+    def series(self, name: str, **labels: str | None) -> list[dict[str, Any]]:
         """The raw ring values of one family, oldest first: counters and
         gauges dump ``value``, histograms ``count``/``sum``."""
+        if labels:
+            name = labeled(name, **labels)
         with self._lock:
             ring = list(self._ring)
         out: list[dict[str, Any]] = []

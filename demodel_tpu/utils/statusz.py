@@ -130,6 +130,9 @@ def _knob_rows() -> list[tuple[str, Any]]:
         ("DEMODEL_SWARM_REAP", env.swarm_reap_enabled()),
         ("DEMODEL_TUNER", tuner_enabled()),
         ("DEMODEL_TELEMETRY_RING", _telemetry_ring_cap()),
+        ("DEMODEL_TELEMETRY_ARCHIVE", env.telemetry_archive_dir() or "off"),
+        ("DEMODEL_TELEMETRY_RETAIN_MB", env.telemetry_retain_mb()),
+        ("DEMODEL_TELEMETRY_RETAIN_HOURS", env.telemetry_retain_hours()),
     ]
 
 
@@ -163,7 +166,9 @@ def effective_config() -> dict[str, dict[str, Any]]:
 
 def _telemetry_summary() -> dict[str, Any]:
     """The statusz-sized slice of the telemetry plane: windowed p99s per
-    histogram family (the full document lives at ``/debug/telemetry``)."""
+    histogram family plus per-series counter rates with their labels
+    intact — the fleet per-peer table joins breaker states against these
+    (the full document lives at ``/debug/telemetry``)."""
     tel = metrics.HUB.telemetry().summary()
     return {
         "snapshots": tel["snapshots"],
@@ -172,6 +177,7 @@ def _telemetry_summary() -> dict[str, Any]:
             name: {w: windows[w]["p99"] for w in windows}
             for name, windows in tel["hist"].items()
         },
+        "rates": tel["rates"],
     }
 
 

@@ -1,0 +1,61 @@
+"""Golden fixture: bare ``acquire()``/``release()`` holds (try/finally).
+
+The lock-learning passes must treat the try/finally idiom as a hold:
+``bump_a``'s write under a bare hold pairs with ``read_a``'s ``with``
+hold of the SAME lock and stays silent — the discriminator for the
+learning itself. ``bump_b`` writes under a bare hold but ``peek_b``
+reads unguarded (guarded-field fires at the write), and ``torn`` splits
+one logical read across two bare holds (atomic-snapshot fires at the
+second acquire).
+"""
+
+import threading
+
+
+class BareHolds:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.a = 0
+        self.b = 0
+        self.items: list = []
+
+    def bump_a(self):                  # submitted to a worker below
+        self._lock.acquire()
+        try:
+            self.a += 1                # bare hold == with-hold: silent
+        finally:
+            self._lock.release()
+
+    def read_a(self):
+        with self._lock:
+            return self.a              # same lock, with-form: silent
+
+    def bump_b(self):                  # submitted to a worker below
+        self._lock.acquire()
+        try:
+            self.b += 1                # guarded write, UNGUARDED read below
+        finally:
+            self._lock.release()
+
+    def peek_b(self):
+        return self.b                  # unguarded read (race pair)
+
+    def torn(self):
+        self._lock.acquire()
+        try:
+            n = len(self.items)
+        finally:
+            self._lock.release()
+        # a concurrent append/clear between the holds makes n stale
+        self._lock.acquire()
+        try:
+            return self.items[:n]
+        finally:
+            self._lock.release()
+
+
+def spawn(ex):
+    c = BareHolds()
+    ex.submit(c.bump_a)
+    ex.submit(c.bump_b)
+    return c.peek_b()

@@ -39,3 +39,11 @@ def reads(tel, route):
     tel.rate("pulls_totl")                               # typo: no write
     tel.window_quantile(f"serve_{route}_seconds", 0.5)   # non-literal read
     HUB.rate("family_nothing_registers")                 # unregistered
+
+
+def history_reads(archive, route):
+    archive.history(family="pulls_total")                # registered: ok
+    archive.history("serve_seconds")                     # positional: ok
+    archive.history()                                    # filterless: ok
+    archive.history(family="pulls_totl")                 # typo: no write
+    archive.history(family=f"serve_{route}_seconds")     # non-literal

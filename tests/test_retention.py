@@ -1,0 +1,388 @@
+"""The telemetry retention plane: durable history across restarts.
+
+The load-bearing claims: (1) every record is ONE complete gzip member, so
+a kill mid-append costs at most the torn tail member — never previously
+written history; (2) a restarted node appends NEXT TO its previous
+incarnation's segments and ``history()`` reads one continuous per-family
+series across both; (3) retention budgets actually evict (bytes and
+age), and never the segment being written; (4) the per-peer label
+attribution survives the whole pipeline — hub counter → window record →
+archived series → ``/debug/telemetry/history`` → fleet per-peer rows.
+"""
+
+from __future__ import annotations
+
+import gzip
+import http.client
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from demodel_tpu.utils import metrics as m
+from demodel_tpu.utils import retention, trace
+from demodel_tpu.utils.faults import PeerHealth
+from demodel_tpu.utils.retention import TelemetryArchive, read_segment
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    trace.reset()
+    m.HUB.reset()
+    PeerHealth.reset_shared()
+    retention._reset_for_tests()
+    yield
+    retention._reset_for_tests()
+    trace.reset()
+    m.HUB.reset()
+    PeerHealth.reset_shared()
+
+
+def _archive(tmp_path, **kw):
+    kw.setdefault("retain_mb", 64)
+    kw.setdefault("retain_hours", 72)
+    kw.setdefault("flush_s", 3600.0)  # tests drive flush_once() by hand
+    return TelemetryArchive(tmp_path / "arch", **kw)
+
+
+def _clocked_telemetry(cap=16):
+    clock = {"t": 0.0}
+    tel = m.Telemetry(m._hub_source(m.HUB), cap=cap, min_gap_s=0.0,
+                      clock=lambda: clock["t"])
+    return tel, clock
+
+
+# ------------------------------------------------- gzip member durability
+
+
+def test_append_round_trips_and_tolerates_torn_tail(tmp_path):
+    arch = _archive(tmp_path)
+    for i in range(5):
+        arch.append({"ts": float(i), "n": i})
+    seg = arch.segments()[0]
+    assert [r["n"] for r in read_segment(seg)] == [0, 1, 2, 3, 4]
+
+    # garbage appended after the last complete member (crash mid-append)
+    with open(seg, "ab") as f:
+        f.write(b"\x1f\x8b\x08\x00GARBAGE-NOT-A-MEMBER")
+    assert [r["n"] for r in read_segment(seg)] == [0, 1, 2, 3, 4]
+
+    # file truncated INSIDE a member: everything before it survives
+    member = gzip.compress(b'{"ts": 99, "n": 99}\n')
+    data = seg.read_bytes() + member[: len(member) // 2]
+    seg.write_bytes(data)
+    assert [r["n"] for r in read_segment(seg)] == [0, 1, 2, 3, 4]
+
+
+def test_rotation_and_byte_retention(tmp_path):
+    arch = _archive(tmp_path, segment_bytes=256)
+    arch.retain_bytes = 600  # tiny: force eviction during the run
+    for i in range(60):
+        arch.append({"ts": float(i), "pad": "x" * 64, "n": i})
+    assert len(arch.segments()) > 1
+    assert arch.segments_evicted > 0
+    # the budget bounds the directory to retain_bytes + ~one segment
+    # (enforcement runs at rotation and never evicts the active segment)
+    total = sum(s.stat().st_size for s in arch.segments())
+    assert total <= 600 + arch.segment_bytes + 256
+    # newest records always survive; the evicted ones are the OLDEST
+    kept = [r["n"] for r in arch.records()]
+    assert kept[-1] == 59
+    assert kept == sorted(kept)
+    assert m.HUB.snapshot().get("telemetry_segments_evicted_total", 0) > 0
+
+
+def test_age_retention(tmp_path):
+    # incompressible pads: every member exceeds segment_bytes, so every
+    # append rotates and the backdated segment's mtime stays stale
+    arch = _archive(tmp_path, segment_bytes=128)
+    arch.retain_s = 3600.0
+    arch.append({"ts": 0.0, "pad": os.urandom(200).hex(), "n": 0})
+    old = arch.segments()[0]
+    stale = time.time() - 7200
+    os.utime(old, (stale, stale))
+    # next rotations see the backdated segment and evict it
+    for i in range(1, 4):
+        arch.append({"ts": float(i), "pad": os.urandom(200).hex(), "n": i})
+    assert old not in arch.segments()
+    assert 0 not in [r["n"] for r in arch.records()]
+
+
+# --------------------------------------------------------- window records
+
+
+def test_flusher_writes_reset_safe_window_records(tmp_path):
+    arch = _archive(tmp_path)
+    tel, clock = _clocked_telemetry()
+    arch.attach("hub", tel)
+
+    m.HUB.inc("pulls_total", 3)
+    m.HUB.observe("serve_seconds", 0.05)
+    clock["t"] = 10.0
+    assert arch.flush_once() == 0  # first sighting is the baseline
+
+    m.HUB.inc("pulls_total", 7)
+    m.HUB.observe("serve_seconds", 0.1)
+    m.HUB.set_gauge("queue_depth", 4)
+    clock["t"] = 40.0
+    assert arch.flush_once() == 1
+
+    (rec,) = arch.records()
+    assert rec["source"] == "hub" and rec["pid"] == os.getpid()
+    assert rec["elapsed_s"] == pytest.approx(30.0)
+    assert rec["counters"]["pulls_total"] == 7  # the delta, not the total
+    assert rec["gauges"]["queue_depth"] == 4
+    h = rec["hists"]["serve_seconds"]
+    assert sum(h["counts"]) == 1 and h["sum"] == pytest.approx(0.1)
+
+    # a quiet window appends nothing
+    clock["t"] = 41.0
+    assert arch.flush_once() == 0
+
+    # counter reset (restart behind a stable name): old treated as zero
+    m.HUB.reset()
+    m.HUB.inc("pulls_total", 2)
+    clock["t"] = 50.0
+    arch.flush_once()
+    assert arch.records()[-1]["counters"]["pulls_total"] == 2
+
+
+def test_history_reconstruction_and_filters(tmp_path):
+    arch = _archive(tmp_path)
+    tel, clock = _clocked_telemetry()
+    arch.attach("hub", tel)
+    for i in range(1, 4):
+        m.HUB.inc("pulls_total", 10)
+        m.HUB.inc(m.labeled("peer_retries_total", peer="tpu-a"), i)
+        m.HUB.observe("serve_seconds", 0.02 * i)
+        clock["t"] = 10.0 * i
+        arch.flush_once()
+
+    doc = arch.history()
+    assert doc["history"] == 1 and doc["incarnations"] == 1
+    pulls = doc["series"]["pulls_total"]
+    assert len(pulls) == 2  # first window is the baseline
+    assert all(p["delta"] == 10 for p in pulls)
+    assert pulls[0]["rate"] == pytest.approx(1.0)
+    hist = doc["series"]["serve_seconds"]
+    assert hist[-1]["count"] == 1 and hist[-1]["p99"] > 0
+
+    fam = arch.history(family="pulls_total")
+    assert set(fam["series"]) == {"pulls_total"}
+    lab = arch.history(family="peer_retries_total", label="peer=tpu-a")
+    assert set(lab["series"]) == {'peer_retries_total{peer="tpu-a"}'}
+    none = arch.history(family="peer_retries_total", label="peer=tpu-b")
+    assert none["series"] == {}
+    # ts is wall-clock: an until= before any window matches nothing
+    cut = arch.history(until=0.0)
+    assert cut["series"] == {} and cut["records"] == 0
+
+
+# ---------------------------------------------------- restart survival
+
+
+_CHILD = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    from demodel_tpu.utils import metrics as m
+    from demodel_tpu.utils import retention
+
+    archive = retention.ensure()
+    assert archive is not None
+    for i in range(6):
+        m.HUB.inc("pulls_total", 5)
+        m.HUB.inc(m.labeled("peer_retries_total", peer="tpu-a"))
+        archive.flush_once()
+        time.sleep(0.05)
+    # die WITHOUT close(): no final flush, no atexit — the kill case
+    os._exit(0)
+""")
+
+
+def test_restart_survival_spans_incarnations(tmp_path, monkeypatch):
+    """Two incarnations (kill → restart) share one archive directory;
+    history() reads one continuous series covering both pids."""
+    root = tmp_path / "arch"
+    env = dict(os.environ, DEMODEL_TELEMETRY_ARCHIVE=str(root),
+               DEMODEL_TELEMETRY_FLUSH_MS="50", JAX_PLATFORMS="cpu")
+    pids = []
+    for _ in range(2):
+        proc = subprocess.run([sys.executable, "-c",
+                               _CHILD.format(repo=str(REPO))],
+                              env=env, capture_output=True, text=True,
+                              timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        pids.append(None)
+
+    monkeypatch.setenv("DEMODEL_TELEMETRY_ARCHIVE", str(root))
+    arch = retention.ensure()
+    doc = arch.history(family="pulls_total")
+    assert doc["incarnations"] >= 2
+    pts = doc["series"]["pulls_total"]
+    assert len(pts) >= 2
+    # one continuous series: monotonically ordered wall-clock points
+    ts = [p["ts"] for p in pts]
+    assert ts == sorted(ts)
+    # per-peer attribution survived the restart too
+    lab = arch.history(family="peer_retries_total", label="peer=tpu-a")
+    assert lab["series"]
+
+
+# ------------------------------------------------- the history endpoint
+
+
+def _get_json(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path, headers={"Connection": "close"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def test_history_endpoint_404_without_archive(tmp_path):
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+    from demodel_tpu.store import Store
+
+    store = Store(tmp_path / "s")
+    with RestoreServer(RestoreRegistry(store), host="127.0.0.1") as srv:
+        status, doc = _get_json(srv.port, "/debug/telemetry/history")
+        assert status == 404
+        assert "DEMODEL_TELEMETRY_ARCHIVE" in doc["error"]
+
+
+def test_history_endpoint_serves_archived_series(tmp_path, monkeypatch):
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+    from demodel_tpu.store import Store
+
+    monkeypatch.setenv("DEMODEL_TELEMETRY_ARCHIVE", str(tmp_path / "arch"))
+    store = Store(tmp_path / "s")
+    with RestoreServer(RestoreRegistry(store), host="127.0.0.1") as srv:
+        # drive traffic and give the ring two distinct-wall snapshots
+        m.HUB.inc("pulls_total", 4)
+        m.HUB.inc(m.labeled("peer_retries_total", peer="tpu-a"), 2)
+        arch = retention.current()
+        assert arch is not None
+        arch.flush_once()
+        time.sleep(0.35)  # the hub ring's min sample gap
+        m.HUB.inc("pulls_total", 6)
+        m.HUB.inc(m.labeled("peer_retries_total", peer="tpu-a"))
+        status, doc = _get_json(
+            srv.port, "/debug/telemetry/history?family=pulls_total")
+        assert status == 200
+        assert doc["history"] == 1 and doc["server"] == "restore"
+        pts = doc["series"]["pulls_total"]
+        assert sum(p["delta"] for p in pts) == pytest.approx(6)
+        # label-filtered per-peer view over the same archive
+        status, lab = _get_json(
+            srv.port, "/debug/telemetry/history"
+                      "?family=peer_retries_total&label=peer=tpu-a")
+        assert status == 200
+        assert list(lab["series"]) == ['peer_retries_total{peer="tpu-a"}']
+
+
+# --------------------------------------- per-peer attribution end-to-end
+
+
+def test_per_peer_attribution_statusz_and_fleet(tmp_path, monkeypatch):
+    """count_retry(peer=...) → labeled hub counter → statusz telemetry
+    rates (labels intact) → tools/statusz.py fleet per-peer rows."""
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+    from demodel_tpu.store import Store
+    from demodel_tpu.utils import faults
+
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import statusz as statusz_cli
+    finally:
+        sys.path.pop(0)
+
+    store = Store(tmp_path / "s")
+    with RestoreServer(RestoreRegistry(store), host="127.0.0.1") as srv:
+        for _ in range(3):
+            faults.count_retry("tpu-b", 0.01)
+        m.HUB.telemetry().sample()
+        time.sleep(0.35)
+        faults.count_retry("tpu-b", 0.01)
+        status, doc = _get_json(srv.port, "/debug/statusz")
+        assert status == 200
+        rates = doc["telemetry"]["rates"]
+        assert any(k.startswith('peer_retries_total{peer="tpu-b"}')
+                   for k in rates), sorted(rates)
+        rows = statusz_cli._peer_rows(doc)
+        row = next(r for r in rows if r["peer"] == "tpu-b")
+        assert row.get("retry_rate_30s") is not None
+        fleet = statusz_cli.fleet_report([f"127.0.0.1:{srv.port}"])
+        assert fleet["hosts"][0]["peers"]
+
+
+# ------------------------------------------------------- report tooling
+
+
+def test_telemetry_report_tool_over_archive(tmp_path):
+    arch = _archive(tmp_path)
+    tel, clock = _clocked_telemetry()
+    arch.attach("hub", tel)
+    for i in range(1, 4):
+        m.HUB.inc("pulls_total", 10)
+        m.HUB.observe("serve_seconds", 0.01 * i)
+        clock["t"] = 10.0 * i
+        arch.flush_once()
+    proc = subprocess.run(
+        [sys.executable, "tools/telemetry_report.py", str(arch.root)],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["metric"] == "telemetry_report"
+    assert out["records"] == 2 and out["incarnations"] == 1
+    assert out["families"]["pulls_total"]["rate"]["last"] == \
+        pytest.approx(1.0)
+    assert out["families"]["serve_seconds"]["p99"]["points"] == 2
+    # --validate is the CI gate: rc 0 with records, nonzero when empty
+    proc = subprocess.run(
+        [sys.executable, "tools/telemetry_report.py", str(arch.root),
+         "--validate"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    proc = subprocess.run(
+        [sys.executable, "tools/telemetry_report.py", str(empty),
+         "--validate"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+
+
+def test_ship_mode_archives_fleet_ticks(tmp_path):
+    """--ship's pod archive: fleet ticks land as appended records that
+    telemetry_report renders as per-host series."""
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+
+    arch = TelemetryArchive(tmp_path / "pod")
+    for i in range(3):
+        arch.append({
+            "metric": "telemetry_fleet", "ts": 100.0 + 10 * i,
+            "interval_s": 10, "unreachable": [],
+            "hosts": [{"host": "n1:9000",
+                       "rate_30s": {"pulls_total": 1.5 + i},
+                       "p99_30s": {"serve_seconds": 0.02}}],
+        })
+    arch.close()
+    out = telemetry_report.report(arch.records())
+    assert out["records"] == 3 and out["hosts"] == ["n1:9000"]
+    env = out["families"]["pulls_total@n1:9000"]["rate"]
+    assert env["points"] == 3 and env["last"] == pytest.approx(3.5)
+    # node window reads over the same directory skip the fleet ticks
+    assert arch.history()["records"] == 0

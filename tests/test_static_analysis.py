@@ -123,6 +123,9 @@ GOLDEN = {
         ("metric-hygiene", 39),
         ("metric-hygiene", 40),
         ("metric-hygiene", 41),
+        # the retention side: archive.history(family=...) lookups
+        ("metric-hygiene", 48),
+        ("metric-hygiene", 49),
     },
     # PR 5 receiver-typing upgrades: blocking I/O reached only through a
     # constructor-typed self-attribute / an executor-submit edge
@@ -144,6 +147,13 @@ GOLDEN = {
     "snapshot_bad.py": {
         ("atomic-snapshot", 19),
         ("atomic-snapshot", 32),
+    },
+    # the try/finally idiom: bare acquire()/release() pairs learned as
+    # lock holds by BOTH concurrency passes — bump_a (bare hold) vs
+    # read_a (with-hold of the same lock) is the silent discriminator
+    "acquire_bad.py": {
+        ("guarded-field", 36),
+        ("atomic-snapshot", 50),
     },
     "parity_bad.py": {
         ("surface-parity", 11),   # knob default drift native↔Python

@@ -118,6 +118,56 @@ def test_ring_eviction_at_cap():
     assert tel.rate("evict_total", 100) == pytest.approx(3 / 3.0)
 
 
+def test_per_label_windowed_views():
+    """The per-peer attribution surface: label kwargs on the windowed
+    views select ONE labeled series, and label_rates fans a family out
+    into every live series with its labels intact."""
+    tel, clock = _clocked_telemetry()
+    m.HUB.inc(m.labeled("peer_retries_total", peer="tpu-a"), 3)
+    m.HUB.inc(m.labeled("peer_retries_total", peer="tpu-b"), 1)
+    m.HUB.inc("pulls_total", 5)
+    tel.sample()
+    clock["t"] = 10.0
+    m.HUB.inc(m.labeled("peer_retries_total", peer="tpu-a"), 7)
+    m.HUB.observe(m.labeled("stage_duration_seconds", span="place"), 0.05)
+    tel.sample()
+    assert tel.rate("peer_retries_total", 10, peer="tpu-a") == \
+        pytest.approx(0.7)
+    assert tel.rate("peer_retries_total", 10, peer="tpu-b") == 0.0
+    assert tel.window_quantile("stage_duration_seconds", 0.99, 10,
+                               span="place") == pytest.approx(0.0512)
+    rates = tel.label_rates("peer_retries_total", 10)
+    assert rates == {'peer_retries_total{peer="tpu-a"}':
+                     pytest.approx(0.7)}
+    # the hub facade forwards the same kwargs
+    assert m.HUB.rate is not None
+    # the windowed reads above freshen (min_gap 0), appending extra
+    # same-valued snapshots — assert the endpoints, not the count
+    series = tel.series("peer_retries_total", peer="tpu-a")
+    assert series[0]["value"] == 3 and series[-1]["value"] == 10
+
+
+def test_parse_labels_round_trip():
+    name = m.labeled("peer_retries_total", peer="tpu-a",
+                     note='quo"te\\back')
+    base, labels = m.parse_labels(name)
+    assert base == "peer_retries_total"
+    assert labels == {"peer": "tpu-a", "note": 'quo"te\\back'}
+    assert m.parse_labels("pulls_total") == ("pulls_total", {})
+
+
+def test_summary_carries_per_series_rates_with_labels():
+    tel, clock = _clocked_telemetry()
+    m.HUB.inc(m.labeled("peer_retries_total", peer="tpu-a"))
+    tel.sample()
+    clock["t"] = 10.0
+    m.HUB.inc(m.labeled("peer_retries_total", peer="tpu-a"), 9)
+    tel.sample()
+    rates = tel.summary()["rates"]
+    key = 'peer_retries_total{peer="tpu-a"}'
+    assert key in rates and rates[key]["30"] > 0
+
+
 def test_empty_window_behavior():
     # high min-gap: freshen() may take the FIRST snapshot (empty ring)
     # but never piles extras onto the injected clock

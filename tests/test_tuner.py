@@ -208,10 +208,94 @@ def test_budget_headroom_gates_prefetch_raise():
     t.streams = t.max_streams
     t.window_bytes = t.max_window
     for _ in range(10):
+        # hbm_pressure forced quiet: this test isolates the RAISE gate
+        # (the full budget would otherwise trip the device-shed path,
+        # covered by test_hbm_pressure_sheds_prefetch_and_gates_probe)
         t.tick(thr=100.0, retry_rate=0.0, breaker_open=False,
-               budget_wait_share=0.0)
+               budget_wait_share=0.0, hbm_pressure=0.0)
     assert t.prefetch_depth == 2, \
         "no budget headroom → no prefetch probe"
+
+
+def test_place_latency_pressure_sheds_prefetch():
+    """The device-fed loop: a slow place/sink-deliver p99 (forced via the
+    tick seam) sheds prefetch depth BEFORE the admission-wait signal is
+    even consulted — depth is what converts place latency into pinned
+    host RAM."""
+    t = _tuner(prefetch_depth=4)
+    t.tick(thr=100.0, retry_rate=0.0, breaker_open=False,
+           budget_wait_share=0.0, place_p99=5.0)
+    assert t.prefetch_depth == 3
+    h = m.HUB.snapshot()
+    assert h['tuner_decisions_total{action="decrease"}'] == 1
+    assert m.HUB.gauges()["tuner_place_p99"] == pytest.approx(5.0)
+
+
+def test_hbm_pressure_sheds_prefetch_and_gates_probe():
+    class Budget:
+        max_bytes = 1 << 30
+        in_use = 0
+
+    t = _tuner(prefetch_depth=3, budget=Budget())
+    t.tick(thr=100.0, retry_rate=0.0, breaker_open=False,
+           budget_wait_share=0.0, hbm_pressure=0.95)
+    assert t.prefetch_depth == 2
+    assert m.HUB.gauges()["tuner_hbm_pressure"] == pytest.approx(0.95)
+    # at the floor, sustained pressure must also gate the upward probe:
+    # prefetch never rises while the device plane is the bottleneck
+    t2 = _tuner(prefetch_depth=1)
+    t2.streams = t2.max_streams
+    t2.window_bytes = t2.max_window
+    for _ in range(10):
+        t2.tick(thr=100.0, retry_rate=0.0, breaker_open=False,
+                budget_wait_share=0.0, hbm_pressure=0.95)
+    assert t2.prefetch_depth == 1
+
+
+def test_device_shed_is_a_span_event():
+    """A LIVE tick thread reading a charged budget sheds prefetch and the
+    decision lands on the tuner span with the device reason — the
+    acceptance shape: signal → shed → span event + decision counter."""
+    class Charged:
+        max_bytes = 1 << 20
+        in_use = 1 << 20  # fully charged: hbm_pressure 1.0
+
+    t = _tuner(prefetch_depth=3, budget=Charged())
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and t.prefetch_depth > 1:
+            time.sleep(0.02)
+    finally:
+        t.stop()
+    assert t.prefetch_depth == 1  # shed to the floor, never below
+    h = m.HUB.snapshot()
+    assert h.get('tuner_decisions_total{action="decrease"}', 0) >= 2
+    recs = [r for r in trace.recorder().snapshot() if r["name"] == "tuner"]
+    reasons = [e["attrs"]["reason"] for r in recs
+               for e in r.get("events", ()) if e["name"] == "tune"]
+    assert any("hbm-pressure" in r for r in reasons), reasons
+
+
+def test_device_signals_default_from_telemetry_and_budget():
+    """Unforced ticks read the live planes: the place-stage histogram
+    feeds place_p99 and the ByteBudget's charge feeds hbm_pressure."""
+    class Charged:
+        max_bytes = 1 << 20
+        in_use = (1 << 20) - 1024
+
+    t = _tuner(prefetch_depth=2, budget=Charged())
+    tel = t._tel()
+    tel.sample()
+    m.HUB.observe(m.labeled("stage_duration_seconds", span="place"), 2.0)
+    time.sleep(0.01)
+    tel.sample()
+    t.tick(retry_rate=0.0, breaker_open=False, budget_wait_share=0.0)
+    g = m.HUB.gauges()
+    assert g["tuner_place_p99"] > 1.0
+    assert g["tuner_hbm_pressure"] == pytest.approx(1023 / 1024, rel=1e-3)
+    # and the derived pressure drove the same shed path
+    assert t.prefetch_depth == 1
 
 
 def test_decisions_are_span_events_and_gauges():

@@ -7,11 +7,13 @@ hold with state read under the second — a concurrent writer between the
 holds makes the two halves describe different worlds, tearing the
 "snapshot" the method claims to produce.
 
-Model: every ``with <lock>:`` statement is a *region* of that lock, and
-every ``x = self.m(...)`` call whose resolved callee's ``acquires-lock``
-summary (through the call graph, bounded) contains a lock is a region of
-that lock too (the hold happens inside the callee on the method's
-behalf). A def-use edge that CROSSES region boundaries of one lock —
+Model: every ``with <lock>:`` statement is a *region* of that lock, the
+try/finally idiom — a bare statement-position ``X.acquire()`` whose next
+sibling is a ``try:`` releasing the same lock in its ``finally:`` — is a
+region over the ``try`` body, and every ``x = self.m(...)`` call whose
+resolved callee's ``acquires-lock`` summary (through the call graph,
+bounded) contains a lock is a region of that lock too (the hold happens
+inside the callee on the method's behalf). A def-use edge that CROSSES region boundaries of one lock —
 a name assigned inside region 1, not reassigned in between, consumed
 inside a later region 2 of the same lock, in the same function — is the
 finding; blame carries both holds.
@@ -50,7 +52,7 @@ class _Region:
     end_line: int
     defs: set
     uses: set
-    kind: str  # "with" | "call"
+    kind: str  # "with" | "call" | "acquire"
 
 
 def _names(node: ast.AST, ctx_type) -> set[str]:
@@ -59,6 +61,18 @@ def _names(node: ast.AST, ctx_type) -> set[str]:
         if isinstance(sub, ast.Name) and isinstance(sub.ctx, ctx_type):
             out.add(sub.id)
     return out
+
+
+def _next_sibling(stmt: ast.stmt) -> ast.AST | None:
+    parent = getattr(stmt, "_dm_parent", None)
+    if parent is None:
+        return None
+    for fname in ("body", "orelse", "finalbody"):
+        seq = getattr(parent, fname, None)
+        if isinstance(seq, list) and stmt in seq:
+            i = seq.index(stmt)
+            return seq[i + 1] if i + 1 < len(seq) else None
+    return None
 
 
 def _live_uses(node: ast.AST) -> set[str]:
@@ -126,8 +140,48 @@ class AtomicSnapshotPass(Pass):
                     ctx, sub, sub.value, {sub.targets[0].id}))
             elif isinstance(sub, (ast.Expr, ast.Return)) \
                     and isinstance(sub.value, ast.Call):
-                out.extend(self._call_region(ctx, sub, sub.value, set()))
+                acq = self._acquire_region(ctx, fn, sub, aliases)
+                if acq is not None:
+                    out.append(acq)
+                else:
+                    out.extend(self._call_region(ctx, sub, sub.value, set()))
         return out
+
+    def _acquire_region(self, ctx: ModuleContext, fn: ast.AST,
+                        stmt: ast.stmt, aliases) -> _Region | None:
+        """Region for the try/finally idiom: a bare statement-position
+        ``X.acquire()`` (no args — a ``timeout=`` acquire is conditional,
+        holding is not certain) whose NEXT SIBLING is a ``try:`` that
+        releases the same lock in its ``finally:``. The region spans the
+        ``try`` body — exactly what ``with X:`` would cover."""
+        call = stmt.value  # type: ignore[attr-defined]
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "acquire"
+                and not call.args):
+            return None
+        cls = enclosing_class(stmt)
+        lid = lock_id(ctx, call.func.value, cls, fn, aliases)
+        if lid is None:
+            return None
+        nxt = _next_sibling(stmt)
+        if not isinstance(nxt, ast.Try) or not nxt.finalbody:
+            return None
+        released = any(
+            isinstance(fin, ast.Expr) and isinstance(fin.value, ast.Call)
+            and isinstance(fin.value.func, ast.Attribute)
+            and fin.value.func.attr == "release"
+            and not fin.value.args
+            and lock_id(ctx, fin.value.func.value, cls, fn, aliases) == lid
+            for fin in nxt.finalbody
+        )
+        if not released:
+            return None
+        body = ast.Module(body=list(nxt.body), type_ignores=[])
+        return _Region(lock=lid, node=nxt, line=stmt.lineno,
+                       end_line=nxt.end_lineno or nxt.lineno,
+                       defs=_names(body, ast.Store),
+                       uses=_live_uses(body), kind="acquire")
 
     def _call_region(self, ctx: ModuleContext, stmt: ast.stmt,
                      call: ast.Call, defs: set) -> list[_Region]:

@@ -145,36 +145,96 @@ class _MethodFacts:
     calls: list = field(default_factory=list)
 
 
+def _resolve_lock(ctx: ModuleContext, expr: ast.expr, where: ast.AST,
+                  aliases: dict | None,
+                  cls_lock_attrs: set[str] | None) -> str | None:
+    """Lock identity of ``expr`` at ``where``: :func:`lock_id` first,
+    then the per-class known-lock-attribute fallback (``self._cv`` bound
+    to a Condition over a lock)."""
+    cls = enclosing_class(where)
+    efn = enclosing_function(where)
+    lid = lock_id(ctx, expr, cls, efn, aliases)
+    if lid is None and cls_lock_attrs \
+            and isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" \
+            and expr.attr in cls_lock_attrs \
+            and cls is not None:
+        lid = f"{ctx.module}.{cls.name}.{expr.attr}"
+    return lid
+
+
+def acquire_regions(ctx: ModuleContext, fn: ast.AST,
+                    aliases: dict | None,
+                    cls_lock_attrs: set[str] | None = None
+                    ) -> list[tuple[str, int, int]]:
+    """``(lock id, acquire line, release line)`` intervals for bare
+    ``X.acquire()`` … ``X.release()`` statement pairs inside ``fn`` —
+    the try/finally idiom ``with`` can't express (e.g. conditional
+    release, hold spanning a loop iteration boundary).
+
+    Only STATEMENT-position, argument-free calls count: ``ok =
+    lock.acquire(timeout=…)`` is a conditional acquire (holding is not
+    certain), and ``budget.acquire(nbytes)`` is a different protocol
+    entirely. Pairing is stack-like per lock id — each ``release()``
+    closes the most recent unmatched ``acquire()`` of the same lock."""
+    cached = getattr(fn, "_dm_acquire_regions", None)
+    if cached is not None:
+        return cached
+    events: list[tuple[int, str, str]] = []
+    for sub in walk_in_scope(fn):
+        if not (isinstance(sub, ast.Expr) and isinstance(sub.value, ast.Call)):
+            continue
+        call = sub.value
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("acquire", "release")
+                and not call.args):
+            continue
+        lid = _resolve_lock(ctx, call.func.value, sub, aliases,
+                            cls_lock_attrs)
+        if lid is not None:
+            events.append((sub.lineno, call.func.attr, lid))
+    regions: list[tuple[str, int, int]] = []
+    open_by_lock: dict[str, list[int]] = {}
+    for line, kind, lid in sorted(events):
+        if kind == "acquire":
+            open_by_lock.setdefault(lid, []).append(line)
+        else:
+            stack = open_by_lock.get(lid)
+            if stack:
+                regions.append((lid, stack.pop(), line))
+    fn._dm_acquire_regions = regions  # one module owns each fn node
+    return regions
+
+
 def _held_locks(node: ast.AST, ctx: ModuleContext, fn: ast.AST,
                 aliases: dict | None,
                 cls_lock_attrs: set[str] | None = None) -> set[str]:
     """Lock ids of every ``with``-statement enclosing ``node`` inside
-    ``fn``. A node inside a ``withitem`` (the lock expression being
-    acquired) does not count that With as held. ``cls_lock_attrs`` are
-    extra ``self.<attr>`` names known to BE locks for the enclosing
-    class even when not lock-named — ``self._cv = threading.Condition(
-    self._lock)`` makes ``with self._cv:`` hold the underlying lock."""
+    ``fn``, plus every bare ``acquire()``/``release()`` interval (the
+    try/finally idiom) whose span covers the node. A node inside a
+    ``withitem`` (the lock expression being acquired) does not count
+    that With as held. ``cls_lock_attrs`` are extra ``self.<attr>``
+    names known to BE locks for the enclosing class even when not
+    lock-named — ``self._cv = threading.Condition(self._lock)`` makes
+    ``with self._cv:`` hold the underlying lock."""
     held: set[str] = set()
     prev = node
     cur = getattr(node, "_dm_parent", None)
     while cur is not None and cur is not fn:
         if isinstance(cur, (ast.With, ast.AsyncWith)) \
                 and not isinstance(prev, ast.withitem):
-            cls = enclosing_class(cur)
-            efn = enclosing_function(cur)
             for item in cur.items:
-                expr = item.context_expr
-                lid = lock_id(ctx, expr, cls, efn, aliases)
-                if lid is None and cls_lock_attrs \
-                        and isinstance(expr, ast.Attribute) \
-                        and isinstance(expr.value, ast.Name) \
-                        and expr.value.id == "self" \
-                        and expr.attr in cls_lock_attrs \
-                        and cls is not None:
-                    lid = f"{ctx.module}.{cls.name}.{expr.attr}"
+                lid = _resolve_lock(ctx, item.context_expr, cur, aliases,
+                                    cls_lock_attrs)
                 if lid is not None:
                     held.add(lid)
         prev, cur = cur, getattr(cur, "_dm_parent", None)
+    for lid, start, end in acquire_regions(ctx, fn, aliases,
+                                           cls_lock_attrs):
+        # strictly after the acquire statement, up to the release line
+        if start < node.lineno <= end:
+            held.add(lid)
     return held
 
 

@@ -23,14 +23,18 @@ adaptive pull tuner would read as "all quiet" forever. So read-site
 names must (a) resolve to literals exactly like write-site names, and
 (b) name a family some ``inc``/``set_gauge``/``observe`` write in the
 analyzed tree actually registers (checked in :meth:`finalize`, once the
-whole run's write set is known).
+whole run's write set is known). Retention-plane history queries
+(``archive.history(family=...)``) have the same failure mode — a typo'd
+family filter returns an empty (not wrong) series from a full archive —
+and get the same check; a filterless ``history()`` is fine.
 
 Scope: files under ``demodel_tpu/`` plus any file carrying an explicit
 ``# demodel: metrics-plane`` pragma (how the golden fixture opts in).
 Write-site names are COLLECTED from every module in the run (benches and
-tests register families too); the plane itself
-(``demodel_tpu/utils/metrics.py``) is exempt from the read check — its
-methods pass caller-supplied names through parameters.
+tests register families too); the planes themselves
+(``demodel_tpu/utils/metrics.py``, ``demodel_tpu/utils/retention.py``)
+are exempt from the read check — their methods pass caller-supplied
+names through parameters.
 """
 
 from __future__ import annotations
@@ -56,8 +60,13 @@ _READS = {"rate", "window_quantile", "family_rate", "series",
 #: receivers a read call counts under: the hub itself or a telemetry
 #: ring (``tel`` is the tree's idiomatic local for one)
 _READ_RECEIVERS = {"HUB", "hub", "tel", "telemetry"}
-#: the plane itself — its forwarding methods take names as parameters
-_PLANE = "demodel_tpu/utils/metrics.py"
+#: receivers a ``history(family=...)`` lookup counts under — the tree's
+#: idiomatic locals for a TelemetryArchive
+_HISTORY_RECEIVERS = {"archive", "ARCHIVE"}
+#: the planes themselves — their forwarding methods take names as
+#: parameters
+_PLANES = {"demodel_tpu/utils/metrics.py",
+           "demodel_tpu/utils/retention.py"}
 _PRAGMA = "# demodel: metrics-plane"
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
@@ -152,6 +161,18 @@ def _is_read_receiver(value: ast.expr) -> bool:
     return False
 
 
+def _is_history_receiver(value: ast.expr) -> bool:
+    """A TelemetryArchive local, or a ``retention.current()`` /
+    ``retention.ensure()`` call chain."""
+    recv = dotted(value)
+    if recv is not None:
+        return recv.rsplit(".", 1)[-1] in _HISTORY_RECEIVERS
+    if isinstance(value, ast.Call):
+        f = dotted(value.func)
+        return f is not None and f.rsplit(".", 1)[-1] in ("current", "ensure")
+    return False
+
+
 @register
 class MetricHygienePass(Pass):
     id = "metric-hygiene"
@@ -159,8 +180,9 @@ class MetricHygienePass(Pass):
         "metric names passed to Hub.inc/set_gauge/observe must be literal "
         "snake_case (labels only via metrics.labeled) — dynamic names are "
         "unbounded scrape cardinality; telemetry reads (rate/"
-        "window_quantile/...) must name a family some write registers — "
-        "a typo'd read silently returns an empty window"
+        "window_quantile/...) and archive history(family=...) lookups "
+        "must name a family some write registers — a typo'd read "
+        "silently returns an empty window"
     )
 
     def __init__(self) -> None:
@@ -173,11 +195,10 @@ class MetricHygienePass(Pass):
                     or _PRAGMA in ctx.source)
         for node in ast.walk(ctx.tree):
             if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.args):
+                    and isinstance(node.func, ast.Attribute)):
                 continue
             attr = node.func.attr
-            if attr in _METHODS:
+            if attr in _METHODS and node.args:
                 recv = dotted(node.func.value)
                 if recv is None:
                     continue
@@ -194,13 +215,34 @@ class MetricHygienePass(Pass):
                     # benches/tests mint real families too, and the read
                     # check below must not flag them as typos
                     self._written |= resolver.names
-            elif attr in _READS and in_scope and ctx.rel != _PLANE \
+            elif attr in _READS and node.args and in_scope \
+                    and ctx.rel not in _PLANES \
                     and _is_read_receiver(node.func.value):
                 resolver = _Resolver(node, ctx)
                 reason = resolver.resolve(node.args[0])
                 if reason:
                     yield Finding(ctx.rel, node.lineno, self.id,
                                   f"telemetry read: {reason}")
+                else:
+                    for name in resolver.names:
+                        self._reads.append((ctx.rel, node.lineno, name))
+            elif attr == "history" and in_scope \
+                    and ctx.rel not in _PLANES \
+                    and _is_history_receiver(node.func.value):
+                # family filter may arrive positionally or as family=;
+                # a filterless history() (or family=None) is fine
+                name_expr = node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "family"), None)
+                if name_expr is None or (
+                        isinstance(name_expr, ast.Constant)
+                        and name_expr.value is None):
+                    continue
+                resolver = _Resolver(node, ctx)
+                reason = resolver.resolve(name_expr)
+                if reason:
+                    yield Finding(ctx.rel, node.lineno, self.id,
+                                  f"history read: {reason}")
                 else:
                     for name in resolver.names:
                         self._reads.append((ctx.rel, node.lineno, name))

@@ -124,6 +124,31 @@ def _oldest_inflight(flat: list[dict]) -> dict | None:
     return {"name": top.get("name"), "age_sec": top.get("age_sec")}
 
 
+def _peer_rows(doc: dict) -> list[dict]:
+    """Per-peer attribution for one host: join breaker states with the
+    per-peer windowed counter rates the statusz telemetry slice carries
+    (labels intact) — "which peer is retrying/faulting" in one table
+    instead of a family-aggregated number."""
+    from demodel_tpu.utils.metrics import parse_labels
+
+    by_peer: dict[str, dict] = {}
+    rates = (doc.get("telemetry") or {}).get("rates", {})
+    for name, windows in rates.items():
+        base, labels = parse_labels(name)
+        peer = labels.get("peer")
+        if peer is None:
+            continue
+        row = by_peer.setdefault(peer, {"peer": peer})
+        rate_30 = (windows or {}).get("30")
+        if base == "peer_retries_total":
+            row["retry_rate_30s"] = rate_30
+        else:
+            row.setdefault("rates_30s", {})[base] = rate_30
+    for peer, b in (doc.get("breakers") or {}).items():
+        by_peer.setdefault(peer, {"peer": peer})["breaker"] = b.get("state")
+    return [by_peer[p] for p in sorted(by_peer)]
+
+
 def fleet_report(hosts: list[str]) -> dict:
     """The pod view: every host's statusz joined into one line. A host
     that doesn't answer is reported, not fatal — the dead host is
@@ -150,6 +175,9 @@ def fleet_report(hosts: list[str]) -> dict:
             "oldest_inflight": _oldest_inflight(
                 _flatten_inflight(doc.get("inflight_spans", []))),
         }
+        peers = _peer_rows(doc)
+        if peers:
+            entry["peers"] = peers
         for b in entry["swarm"]:
             swarm_total += int(b.get("chunks_total", 0))
             swarm_have += int(b.get("chunks_have", 0))
@@ -249,7 +277,8 @@ def _poll_host(host: str) -> tuple[str, dict | None, str | None]:
 
 
 def watch_fleet(hosts: list[str], interval_s: float,
-                samples: int | None = None, out=None) -> int:
+                samples: int | None = None, out=None,
+                ship: str | None = None) -> int:
     """Poll every host's ``/debug/telemetry`` each interval and emit one
     JSONL line per tick — the continuous pod time series. The polling
     itself drives each node's snapshot ring, so the windows sharpen as
@@ -260,23 +289,38 @@ def watch_fleet(hosts: list[str], interval_s: float,
     from concurrent.futures import ThreadPoolExecutor
 
     out = out if out is not None else sys.stdout
+    archive = None
+    if ship:
+        # the fleet retention story: every tick also lands in a pod-level
+        # TelemetryArchive (gzipped JSONL segments, node retention
+        # budgets apply), which tools/telemetry_report.py renders later
+        from demodel_tpu.utils.retention import TelemetryArchive
+
+        archive = TelemetryArchive(Path(ship))
     n = 0
-    with ThreadPoolExecutor(max_workers=min(32, max(1, len(hosts)))) as ex:
-        while samples is None or n < samples:
-            t0 = _time.monotonic()
-            tick: dict = {"metric": "telemetry_fleet", "ts": _time.time(),
-                          "interval_s": interval_s, "hosts": [],
-                          "unreachable": []}
-            for host, doc, err in ex.map(_poll_host, hosts):
-                if doc is not None:
-                    tick["hosts"].append(_host_telemetry_entry(host, doc))
-                else:
-                    tick["unreachable"].append({"host": host, "error": err})
-            print(json.dumps(tick, default=str), file=out, flush=True)
-            n += 1
-            if samples is None or n < samples:
-                _time.sleep(max(0.0, interval_s
-                                - (_time.monotonic() - t0)))
+    try:
+        with ThreadPoolExecutor(max_workers=min(32, max(1, len(hosts)))) as ex:
+            while samples is None or n < samples:
+                t0 = _time.monotonic()
+                tick: dict = {"metric": "telemetry_fleet", "ts": _time.time(),
+                              "interval_s": interval_s, "hosts": [],
+                              "unreachable": []}
+                for host, doc, err in ex.map(_poll_host, hosts):
+                    if doc is not None:
+                        tick["hosts"].append(_host_telemetry_entry(host, doc))
+                    else:
+                        tick["unreachable"].append({"host": host,
+                                                    "error": err})
+                print(json.dumps(tick, default=str), file=out, flush=True)
+                if archive is not None:
+                    archive.append(tick)
+                n += 1
+                if samples is None or n < samples:
+                    _time.sleep(max(0.0, interval_s
+                                    - (_time.monotonic() - t0)))
+    finally:
+        if archive is not None:
+            archive.close()
     return 0
 
 
@@ -296,16 +340,23 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--samples", metavar="N", type=int,
                     help="with --watch: stop after N samples "
                          "(default: run until interrupted)")
+    ap.add_argument("--ship", metavar="DIR",
+                    help="with --fleet --watch: also append every tick "
+                         "into a pod-level telemetry archive at DIR "
+                         "(render with tools/telemetry_report.py)")
     args = ap.parse_args(argv)
 
     if args.watch is not None and args.watch <= 0:
         ap.error("--watch needs a positive interval")
+    if args.ship and args.watch is None:
+        ap.error("--ship requires --fleet --watch")
     if args.fleet:
         hosts = [h.strip() for h in args.fleet.split(",") if h.strip()]
         if not hosts:
             ap.error("--fleet needs at least one host")
         if args.watch is not None:
-            return watch_fleet(hosts, args.watch, args.samples)
+            return watch_fleet(hosts, args.watch, args.samples,
+                               ship=args.ship)
         print(json.dumps(fleet_report(hosts), default=str))
         return 0
     if args.watch is not None:

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Trajectory report over telemetry archives — ONE JSON line.
+
+Reads one or many ``DEMODEL_TELEMETRY_ARCHIVE`` directories (or single
+``telemetry-*.jsonl.gz`` segments) written by the retention plane
+(:mod:`demodel_tpu.utils.retention`) and renders the per-stage envelope
+over wall-clock: for every family, the rate (counters), windowed p99
+(histograms), and last value (gauges) across every archived window —
+spanning node restarts, because the archive does.
+
+Two record shapes are understood:
+
+- node **window records** (the background flusher's output: counter
+  deltas / gauge lasts / histogram bucket deltas per freshen window);
+- shipped **fleet ticks** (``tools/statusz.py --fleet --watch --ship``):
+  each host's 30 s rates/p99s land as ``family@host`` series.
+
+Same one-JSON-line contract as ``bench.py`` / ``trace_report.py`` /
+``statusz.py`` so drivers can scrape it. ``--validate`` exits nonzero
+unless at least one record parses — the CI retention-smoke gate.
+
+Usage::
+
+    python tools/telemetry_report.py /var/tmp/telemetry-archive
+    python tools/telemetry_report.py nodeA-archive nodeB-archive \\
+        --family pull_bytes_total
+    python tools/telemetry_report.py /tmp/pod-archive --validate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from demodel_tpu.utils.metrics import hist_quantile  # noqa: E402
+from demodel_tpu.utils.retention import (  # noqa: E402
+    TelemetryArchive,
+    read_segment,
+)
+
+
+def load_archive(path: Path) -> list[dict]:
+    """Records of one archive directory (all segments, oldest first) or
+    one bare segment file. A missing path is fatal — the smoke gate's
+    whole point is "the archive exists and parses"."""
+    p = Path(path)
+    if p.is_dir():
+        return TelemetryArchive(p).records()
+    if p.is_file():
+        return read_segment(p)
+    raise SystemExit(f"{path}: no such archive directory or segment")
+
+
+def _family_of(key: str) -> str:
+    """Base family of a series key: strips labels and the ``@host``
+    suffix fleet ticks add."""
+    return key.partition("@")[0].partition("{")[0]
+
+
+def _envelope(points: list[tuple[float, float]]) -> dict:
+    vals = [v for _, v in points]
+    return {
+        "points": len(vals),
+        "max": round(max(vals), 6),
+        "avg": round(sum(vals) / len(vals), 6),
+        "last": round(vals[-1], 6),
+    }
+
+
+def report(records: list[dict], family: str | None = None,
+           since: float | None = None,
+           until: float | None = None) -> dict:
+    rate_pts: dict[str, list[tuple[float, float]]] = {}
+    p99_pts: dict[str, list[tuple[float, float]]] = {}
+    value_pts: dict[str, list[tuple[float, float]]] = {}
+    walls: list[float] = []
+    pids: set[int] = set()
+    sources: set[str] = set()
+    hosts: set[str] = set()
+    used = skipped = 0
+
+    def keep(book: dict, key: str, ts: float, value) -> None:
+        if value is None:
+            return
+        if family is not None and _family_of(key) != family:
+            return
+        book.setdefault(key, []).append((ts, float(value)))
+
+    for rec in sorted(records, key=lambda r: r.get("ts") or 0.0):
+        ts = rec.get("ts")
+        if not isinstance(ts, (int, float)):
+            skipped += 1
+            continue
+        if (since is not None and ts < since) \
+                or (until is not None and ts > until):
+            continue
+        if rec.get("metric") == "telemetry_fleet":
+            # a shipped fleet tick: per-host 30 s windowed views
+            used += 1
+            walls.append(float(ts))
+            for h in rec.get("hosts", []):
+                host = h.get("host", "?")
+                hosts.add(host)
+                for name, value in (h.get("rate_30s") or {}).items():
+                    keep(rate_pts, f"{name}@{host}", float(ts), value)
+                for name, value in (h.get("p99_30s") or {}).items():
+                    keep(p99_pts, f"{name}@{host}", float(ts), value)
+            continue
+        if not any(k in rec for k in ("counters", "gauges", "hists")):
+            skipped += 1
+            continue
+        used += 1
+        walls.append(float(ts))
+        if isinstance(rec.get("pid"), int):
+            pids.add(rec["pid"])
+        if rec.get("source"):
+            sources.add(str(rec["source"]))
+        elapsed = float(rec.get("elapsed_s") or 0.0)
+        for name, delta in (rec.get("counters") or {}).items():
+            if elapsed > 0:
+                keep(rate_pts, name, float(ts), float(delta) / elapsed)
+        for name, value in (rec.get("gauges") or {}).items():
+            keep(value_pts, name, float(ts), value)
+        for name, h in (rec.get("hists") or {}).items():
+            le = [float(b) for b in h.get("le", ())]
+            counts = [int(c) for c in h.get("counts", ())]
+            if sum(counts):
+                keep(p99_pts, name, float(ts),
+                     hist_quantile(le, counts, 0.99))
+
+    families: dict[str, dict] = {}
+    for book, kind in ((rate_pts, "rate"), (p99_pts, "p99"),
+                       (value_pts, "value")):
+        for name in sorted(book):
+            families.setdefault(name, {})[kind] = _envelope(
+                sorted(book[name]))
+    out: dict = {
+        "metric": "telemetry_report",
+        "records": used,
+        "skipped": skipped,
+        "incarnations": len(pids),
+        "families": families,
+    }
+    if walls:
+        out["wall"] = [round(min(walls), 3), round(max(walls), 3)]
+        out["span_s"] = round(max(walls) - min(walls), 3)
+    if sources:
+        out["sources"] = sorted(sources)
+    if hosts:
+        out["hosts"] = sorted(hosts)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("archives", nargs="+", type=Path,
+                    help="telemetry archive directories (or single "
+                         "segment files)")
+    ap.add_argument("--family", metavar="NAME",
+                    help="report only this base family")
+    ap.add_argument("--since", type=float, metavar="EPOCH",
+                    help="drop windows before this wall-clock time")
+    ap.add_argument("--until", type=float, metavar="EPOCH",
+                    help="drop windows after this wall-clock time")
+    ap.add_argument("--validate", action="store_true",
+                    help="parse gate only (CI smoke); nonzero unless at "
+                         "least one record parses")
+    args = ap.parse_args(argv)
+
+    records: list[dict] = []
+    for path in args.archives:
+        records.extend(load_archive(path))
+    if args.validate:
+        if not records:
+            raise SystemExit(
+                f"{', '.join(map(str, args.archives))}: no telemetry "
+                "records decoded")
+        print(json.dumps({"metric": "telemetry_report_validate",
+                          "ok": True, "records": len(records),
+                          "archives": len(args.archives)}))
+        return 0
+    if not records:
+        raise SystemExit(
+            f"{', '.join(map(str, args.archives))}: empty archive")
+    out = report(records, family=args.family, since=args.since,
+                 until=args.until)
+    out["archives"] = len(args.archives)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
