@@ -62,18 +62,17 @@ def pull(
     return report
 
 
-def _maybe_gc(store: Store) -> None:
-    """Enforce the cache size cap after a pull (``DEMODEL_CACHE_MAX_GB``;
-    0 = unbounded). The native proxy enforces the same knob on its serving
-    loop; this covers first-party pull traffic."""
-    from demodel_tpu.utils.env import env_int
+def _enforce_tier_budgets(store: Store) -> None:
+    """Tier-budget-driven eviction after a pull (replaces the old
+    ``_maybe_gc`` periodic sweep): the shared tier trims the host-RAM hot
+    tier to its budget, then the disk tier to ``DEMODEL_CACHE_MAX_GB``
+    (0 = unbounded) via :meth:`Store.gc` — pin shield and
+    ``store_evictions_total`` semantics unchanged. The native proxy
+    enforces the same disk knob on its serving loop; this covers
+    first-party pull traffic."""
+    from demodel_tpu import tier
 
-    max_gb = env_int("DEMODEL_CACHE_MAX_GB", 0)
-    if max_gb > 0:
-        total, freed, evicted = store.gc(max_gb << 30)
-        if evicted:
-            log.info("cache gc: evicted %d objects (%.1f MB); %.1f MB in use",
-                     evicted, freed / 1e6, total / 1e6)
+    tier.shared(store).enforce()
 
 
 def _persist_manifest(store: Store, mkey: str, out: dict,
@@ -241,7 +240,7 @@ def pull_to_hbm(
                     placed.integrity_errors = list(fetcher.integrity_failures)
                     _persist_manifest(store, mkey, out,
                                       {k for k, _ in fails})
-                    _maybe_gc(store)
+                    _enforce_tier_budgets(store)
                 except BaseException as e:  # noqa: BLE001 — surfaced at finalize()
                     placed.finalize_error = e
                 finally:
@@ -260,7 +259,7 @@ def pull_to_hbm(
             # record must not reference keys that never hit the store
             fails = reg.fetcher.flush_writes()
             _persist_manifest(store, mkey, out, {k for k, _ in fails})
-            _maybe_gc(store)
+            _enforce_tier_budgets(store)
             if reg.fetcher.integrity_failures:
                 # optimistic verify found the delivered bytes corrupt —
                 # the placement is poisoned; fail the pull

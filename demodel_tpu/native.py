@@ -40,6 +40,7 @@ NATIVE_LOCK_RANKS = {
     "kRankStoreIndex": 34,
     "kRankStorePin": 36,
     "kRankStoreFd": 38,
+    "kRankStoreHot": 40,
 }
 
 _lock = threading.Lock()

@@ -376,9 +376,32 @@ class PeerSet:
         (or ``expected_digest``), and stores the peer's meta sidecar
         unchanged so the object is indistinguishable from a locally-cached
         one. Returns False when no peer has the key.
+
+        Concurrent calls for one key collapse to a single transfer through
+        the store's shared single-flight registry
+        (:mod:`demodel_tpu.tier`): one caller leads, the rest wait on the
+        outcome and re-read the store. The ``peer:`` key prefix keeps
+        these admission flights apart from the tier read path's
+        watermark flights on the same registry.
         """
         if store.has(key):
             return True
+        from demodel_tpu import tier
+        flights = tier.shared(store).flights
+        got = flights.do(
+            "peer:" + key,
+            lambda: store.has(key)  # a previous leader already landed it
+            or self._fetch_into_once(store, key, expected_digest))
+        if got is None:  # waiter: the leader's outcome is in the store
+            return store.has(key)
+        return bool(got)
+
+    def _fetch_into_once(self, store: Store, key: str,
+                         expected_digest: str | None = None) -> bool:
+        """One un-collapsed :meth:`fetch_into` attempt (the single-flight
+        leader's body). Transport failures degrade to False — the caller
+        falls over to upstream — so the flight always finishes ok and
+        waiters re-read the store rather than re-dialing peers."""
         remote_key = key
         peer = self.locate(key)
         if peer is None and expected_digest:

@@ -170,6 +170,26 @@ def bitmap_indices(hex_str: str, n: int) -> set[int]:
     return {i for i in range(min(n, len(bm) * 8)) if bm[i >> 3] >> (i & 7) & 1}
 
 
+def _charge_ram(delta: int) -> None:
+    """Charge (or release, negative) chunk-board bytes against the
+    shared host-RAM tier budget (``demodel_tpu.tier.ram_budget``):
+    landing swarm chunks push mmap'd hot objects out of the tier
+    instead of overshooting host RAM. Lazy import keeps this module
+    importable without the store stack (the tier module is dep-light
+    but not stdlib-only); called OUTSIDE the board lock so the budget
+    lock never nests under it."""
+    if not delta:
+        return
+    from demodel_tpu import tier
+    budget = tier.ram_budget()
+    if delta > 0:
+        budget.charge(delta)
+        if budget.over() > 0:
+            tier.shed_ram()
+    else:
+        budget.release(-delta)
+
+
 class ChunkBoard:
     """One host's chunk possession + bytes for one swarm pull.
 
@@ -178,6 +198,10 @@ class ChunkBoard:
     drops stale reorderings. Chunks are retained until :meth:`clear` —
     the board IS the peer-serve surface; a host that dropped a chunk the
     swarm still needs would silently push its siblings back to origin.
+    Held bytes are charged to the shared host-RAM tier budget
+    (:func:`demodel_tpu.tier.ram_budget`) and released on reap/clear,
+    so a pull in flight evicts hot-tier objects before it can
+    overshoot host RAM.
     """
 
     def __init__(self, pull_id: str, host_id: str):
@@ -200,12 +224,15 @@ class ChunkBoard:
             self._version += 1
 
     def put(self, key: str, index: int, data: bytes) -> None:
+        data = bytes(data)
         with self._lock:
             if key not in self._files:
                 raise KeyError(f"unknown swarm file {key!r}")
-            self._chunks[(key, index)] = bytes(data)
+            prev = self._chunks.get((key, index))
+            self._chunks[(key, index)] = data
             self._reaped.discard((key, index))  # a re-fetch un-reaps
             self._version += 1
+        _charge_ram(len(data) - (len(prev) if prev is not None else 0))
 
     def get(self, key: str, index: int) -> bytes | None:
         with self._lock:
@@ -237,7 +264,8 @@ class ChunkBoard:
             self._reaped.add((key, index))
             self._bytes_reaped += len(data)
             self._version += 1
-            return len(data)
+        _charge_ram(-len(data))
+        return len(data)
 
     def unreap(self, key: str, index: int) -> None:
         """A local reader needs a reaped chunk after all: clear the flag
@@ -300,10 +328,12 @@ class ChunkBoard:
 
     def clear(self) -> None:
         with self._lock:
+            held = sum(len(b) for b in self._chunks.values())
             self._chunks.clear()
             self._files.clear()
             self._reaped.clear()
             self._version += 1
+        _charge_ram(-held)
 
 
 # ----------------------------------------------------- process board registry

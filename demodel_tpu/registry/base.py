@@ -427,11 +427,24 @@ class Fetcher:
           from the kept partial — digest mismatches and other 4xx never
           retry.
         """
+        from demodel_tpu import tier
         with trace.span("registry-fetch", file=name) as sp:
-            art = self._policy.call(
-                lambda: self._fetch_once(url, name, expected_digest,
-                                         media_type, extra_headers),
-                what=f"fetch {name} (each retry resumes the kept partial)")
+            # single-flight admission on the registry miss edge: N
+            # concurrent fetches of one key cost one upstream transfer —
+            # the leader runs the retried fetch, waiters re-run
+            # _fetch_once afterwards (a cache hit, zero network). The
+            # ``origin:`` prefix keeps these flights apart from the tier
+            # read path's watermark flights on the same registry.
+            art = tier.shared(self.store).flights.do(
+                "origin:" + key_for_uri(url),
+                lambda: self._policy.call(
+                    lambda: self._fetch_once(url, name, expected_digest,
+                                             media_type, extra_headers),
+                    what=f"fetch {name} "
+                         "(each retry resumes the kept partial)"))
+            if art is None:  # waiter — the leader landed it
+                art = self._fetch_once(url, name, expected_digest,
+                                       media_type, extra_headers)
             sp.set_attr("bytes", art.size)
             sp.set_attr("from_peer", art.from_peer)
             sp.set_attr("from_cache", art.from_cache)

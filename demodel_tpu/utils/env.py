@@ -122,6 +122,21 @@ def swarm_reap_enabled() -> bool:
     return env_bool("DEMODEL_SWARM_REAP", True)
 
 
+def cache_max_gb() -> int:
+    """``DEMODEL_CACHE_MAX_GB``: the disk tier's byte budget in GB
+    (0 = unbounded). One resolver for every enforcement point — the
+    native proxy's serving-loop gc, the pull plane's post-pull sweep,
+    and the tier API's :func:`demodel_tpu.tier.enforce_disk_budget`."""
+    return env_int("DEMODEL_CACHE_MAX_GB", 0, minimum=0)
+
+
+def default_tier_ram_mb() -> int:
+    """``DEMODEL_TIER_RAM_MB``: the host-RAM tier's byte budget in MB —
+    mmap'd hot objects AND in-flight swarm chunk boards charge the same
+    budget (chunk landings push hot objects out, never the reverse)."""
+    return env_int("DEMODEL_TIER_RAM_MB", 256, minimum=1)
+
+
 def telemetry_archive_dir() -> str:
     """``DEMODEL_TELEMETRY_ARCHIVE``: directory for the durable telemetry
     archive (:mod:`demodel_tpu.utils.retention`). Empty/unset disables

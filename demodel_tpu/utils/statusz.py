@@ -30,7 +30,9 @@ from demodel_tpu.utils import metrics, trace
 _START_MONOTONIC = time.monotonic()
 _START_WALL = time.time()
 
-SCHEMA_VERSION = 1
+#: v2 added the ``tiers`` section (RAM/disk occupancy, budgets, in-flight
+#: single-flight leaders) on both planes
+SCHEMA_VERSION = 2
 
 
 def _breakers() -> dict[str, dict[str, Any]]:
@@ -61,6 +63,18 @@ def _swarm() -> list[dict[str, Any]]:
     if placement is None:
         return []
     out: list[dict[str, Any]] = placement.boards_snapshot()
+    return out
+
+
+def _tiers() -> list[dict[str, Any]]:
+    """Live tiered-store state (RAM/disk occupancy vs budget, in-flight
+    single-flight leaders) for every TieredStore this process holds —
+    the Python half of the section the native proxy composes from its
+    hot_stats."""
+    tier = sys.modules.get("demodel_tpu.tier")
+    if tier is None:
+        return []
+    out: list[dict[str, Any]] = tier.tiers_snapshot()
     return out
 
 
@@ -128,6 +142,8 @@ def _knob_rows() -> list[tuple[str, Any]]:
         ("DEMODEL_SWARM_ORIGIN_STREAMS",
          env.default_swarm_origin_streams()),
         ("DEMODEL_SWARM_REAP", env.swarm_reap_enabled()),
+        ("DEMODEL_TIER_RAM_MB", env.default_tier_ram_mb()),
+        ("DEMODEL_CACHE_MAX_GB", env.cache_max_gb()),
         ("DEMODEL_TUNER", tuner_enabled()),
         ("DEMODEL_TELEMETRY_RING", _telemetry_ring_cap()),
         ("DEMODEL_TELEMETRY_ARCHIVE", env.telemetry_archive_dir() or "off"),
@@ -204,6 +220,7 @@ def snapshot(extra: dict[str, Any] | None = None) -> dict[str, Any]:
         "breakers": _breakers(),
         "budgets": _budgets(),
         "swarm": _swarm(),
+        "tiers": _tiers(),
         "gossip": _gossip(),
         "config": effective_config(),
         "telemetry": _telemetry_summary(),

@@ -13,7 +13,7 @@
 // Rank order (low = outermost, must be acquired first):
 //   Proxy:  reactor < queue < sessions < fill < leaf < upstream < hint
 //           < restore
-//   Store:  gc < writers < index < pin < fd
+//   Store:  gc < writers < index < pin < fd < hot
 // Proxy locks rank below Store locks because proxy paths call into the
 // store while holding their own locks (register_tensor holds restore_mu_
 // across Store::pin/unpin), never the reverse.
@@ -48,6 +48,7 @@ constexpr int kRankStoreWriters = 32;
 constexpr int kRankStoreIndex = 34;
 constexpr int kRankStorePin = 36;
 constexpr int kRankStoreFd = 38;
+constexpr int kRankStoreHot = 40;  // mmap hot tier — innermost leaf
 
 #ifdef DM_LOCK_ORDER_CHECK
 

@@ -1066,7 +1066,72 @@ class Session {
       }
     }
 
+    // Forward-path single-flight (plain full GETs): concurrent MITM
+    // misses on one store key collapse to a single upstream dial. The
+    // first session claims the store writer and registers fill progress
+    // BEFORE dialing, so every later miss attaches to the growing
+    // partial and streams the full body off its watermark instead of
+    // re-pulling from upstream (the ranged-miss path above has done
+    // this for 206s all along).
+    Writer *sf_w = nullptr;
+    std::shared_ptr<FillState> sf_fill;
+    if (cacheable && is_get && p_->store_ && range.empty()) {
+      std::string werr;
+      sf_w = p_->store_->begin(key, false, &werr);
+      if (sf_w) {
+        sf_fill = std::make_shared<FillState>();
+        std::lock_guard<Mutex> g(p_->fill_mu_);
+        p_->fills_[key] = sf_fill;
+      } else {
+        // the leader claims the writer a hair before registering its
+        // fill — poll briefly before concluding a non-proxy writer owns
+        // the partial (a missed beat here would cost a second origin
+        // dial, the exact thing single-flight exists to prevent)
+        std::shared_ptr<FillState> fill;
+        for (int spin = 0; spin < 50 && !fill; spin++) {
+          {
+            std::lock_guard<Mutex> g(p_->fill_mu_);
+            auto it = p_->fills_.find(key);
+            if (it != p_->fills_.end()) fill = it->second;
+          }
+          if (fill || p_->store_->has(key)) break;
+          std::this_thread::sleep_for(std::chrono::milliseconds(2));
+        }
+        if (fill) {
+          int served = serve_full_from_fill(req, uri, key, fill);
+          if (served >= 0) return served != 0;
+        }
+        if (p_->store_->has(key)) {  // the fill landed while we looked
+          p_->metrics_.cache_hits++;
+          return serve_from_cache(req, uri, key);
+        }
+        // no fill to attach to (a non-proxy writer owns the partial):
+        // stream uncached, exactly the old behavior
+      }
+    }
+    auto sf_abort = [&]() {
+      if (sf_w) {
+        sf_w->abort(true);
+        delete sf_w;
+        sf_w = nullptr;
+      }
+      if (sf_fill) {
+        {
+          std::lock_guard<std::mutex> g(sf_fill->mu);
+          sf_fill->done = true;
+          sf_fill->ok = false;
+        }
+        sf_fill->cv.notify_all();
+        std::lock_guard<Mutex> g(p_->fill_mu_);
+        auto it = p_->fills_.find(key);
+        if (it != p_->fills_.end() && it->second == sf_fill)
+          p_->fills_.erase(it);
+        sf_fill.reset();
+      }
+    };
+
     if (!ensure_upstream(authority, host, port, tls)) {
+      sf_abort();
       if (cacheable && p_->store_->has(key)) {
         // stale-if-error: a TTL-expired challenge (or any cached copy)
         // beats a 502 while the registry is unreachable — revalidation
@@ -1084,6 +1149,7 @@ class Session {
       upstream_authority_.clear();
       if (!ensure_upstream(authority, host, port, tls) ||
           !send_upstream_request(req, body)) {
+        sf_abort();
         p_->metrics_.errors++;
         send_simple(&client_, 502, "Bad Gateway", "upstream send failed");
         return false;
@@ -1092,6 +1158,7 @@ class Session {
 
     ResponseHead resp;
     if (!parse_response_head(&upstream_, &resp)) {
+      sf_abort();
       upstream_.shutdown_close();
       upstream_authority_.clear();
       p_->metrics_.errors++;
@@ -1099,7 +1166,8 @@ class Session {
       return false;
     }
     upstream_first_byte();
-    return stream_response(req, resp, uri, key, cacheable, auth_scope);
+    return stream_response(req, resp, uri, key, cacheable, auth_scope, sf_w,
+                           sf_fill);
   }
 
   // A cached LFS redirect is only safe to replay while the blob bytes it
@@ -1389,6 +1457,21 @@ class Session {
     return (client_ok && upstream_ok) ? 1 : 0;
   }
 
+  // Fill-watermark wait under the io timeout. Deliberately wait_until on
+  // the SYSTEM clock: libstdc++ lowers a steady-clock wait_for to
+  // pthread_cond_clockwait, which older libtsan builds do not intercept —
+  // the hidden unlock inside the wait then reads as impossible lock
+  // states (bogus double-lock reports) in the TSan selftest.
+  // pthread_cond_timedwait is intercepted everywhere.
+  template <class Pred>
+  bool fill_wait(std::unique_lock<std::mutex> &lk, FillState &f, Pred pred) {
+    return f.cv.wait_until(
+        lk,
+        std::chrono::system_clock::now() +
+            std::chrono::seconds(p_->cfg_.io_timeout_sec),
+        pred);
+  }
+
   // Attach to another session's in-flight fill: wait for bytes to land in
   // partial/{key} and stream our client's window from there. Returns 1
   // (served, keep conn), 0 (close conn), or -1 (not servable — fill was
@@ -1400,9 +1483,8 @@ class Session {
     {
       // the filler may still be waiting on the upstream response head
       std::unique_lock<std::mutex> lk(fill->mu);
-      bool got = fill->cv.wait_for(
-          lk, std::chrono::seconds(p_->cfg_.io_timeout_sec),
-          [&] { return fill->total >= 0 || fill->done; });
+      bool got = fill_wait(lk, *fill,
+                           [&] { return fill->total >= 0 || fill->done; });
       if (!got || fill->total < 0) return -1;  // fill never produced a size
       size = fill->total;
     }
@@ -1447,9 +1529,8 @@ class Session {
       int64_t need = off + sent + 1;  // need at least one byte past off+sent
       {
         std::unique_lock<std::mutex> lk(fill->mu);
-        bool got = fill->cv.wait_for(
-            lk, std::chrono::seconds(p_->cfg_.io_timeout_sec),
-            [&] { return fill->written >= need || fill->done; });
+        bool got = fill_wait(
+            lk, *fill, [&] { return fill->written >= need || fill->done; });
         if (!got || (fill->done && !fill->ok && fill->written < need)) {
           ok = false;  // filler stalled or failed before our bytes arrived
           break;
@@ -1464,6 +1545,80 @@ class Session {
       if (avail <= 0) continue;
       int64_t want = std::min<int64_t>(avail, (int64_t)buf.size());
       ssize_t n = ::pread(fd, buf.data(), static_cast<size_t>(want), off + sent);
+      if (n <= 0) {
+        ok = false;
+        break;
+      }
+      if (!client_.write_all(buf.data(), static_cast<size_t>(n))) {
+        ok = false;
+        break;
+      }
+      sent += n;
+      p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
+      p_->metrics_.serve_bytes += static_cast<uint64_t>(n);
+    }
+    ::close(fd);
+    return ok ? 1 : 0;
+  }
+
+  // Attach to another session's in-flight PLAIN miss fill: reply a full
+  // 200 whose body streams off the growing partial as the filler's
+  // watermark advances — the forward-path single-flight's waiter leg
+  // (the ranged-miss path has served 206s this way all along). Returns
+  // 1 (served, keep conn), 0 (close conn), or -1 (not servable — the
+  // fill finished or died before we attached; the caller re-checks the
+  // store, then falls back to its own upstream dial).
+  int serve_full_from_fill(const RequestHead &req, const std::string &uri,
+                           const std::string &key,
+                           const std::shared_ptr<FillState> &fill) {
+    int64_t size;
+    {
+      // the filler may still be waiting on the upstream response head
+      std::unique_lock<std::mutex> lk(fill->mu);
+      bool got = fill_wait(lk, *fill,
+                           [&] { return fill->total >= 0 || fill->done; });
+      if (!got || fill->done || fill->total < 0) return -1;
+      size = fill->total;
+    }
+    // open the partial before replying; if the fill committed and the
+    // file was renamed away, the caller serves from cache instead
+    std::string part = p_->store_->root() + "/partial/" + key;
+    int fd = ::open(part.c_str(), O_RDONLY);
+    if (fd < 0) return -1;
+
+    std::string head = "HTTP/1.1 200 OK\r\n";
+    head += cors_headers(req);
+    head += "Content-Length: " + std::to_string(size) + "\r\n";
+    head += "Accept-Ranges: bytes\r\nX-Demodel-Cache: FILL-ATTACH\r\n"
+            "Connection: keep-alive\r\n\r\n";
+    if (!client_.write_all(head.data(), head.size())) {
+      ::close(fd);
+      return 0;
+    }
+    log_response(req, uri, 200, "", size, false);
+
+    std::vector<char> buf(1 << 20);
+    int64_t sent = 0;
+    bool ok = true;
+    while (sent < size) {
+      {
+        std::unique_lock<std::mutex> lk(fill->mu);
+        bool got = fill_wait(
+            lk, *fill, [&] { return fill->written > sent || fill->done; });
+        if (!got || (fill->done && !fill->ok && fill->written <= sent)) {
+          ok = false;  // filler stalled or failed before our bytes arrived
+          break;
+        }
+      }
+      int64_t avail;
+      {
+        std::lock_guard<std::mutex> g(fill->mu);
+        avail = fill->written - sent;
+        if (fill->done && fill->ok) avail = size - sent;
+      }
+      if (avail <= 0) continue;
+      int64_t want = std::min<int64_t>(avail, (int64_t)buf.size());
+      ssize_t n = ::pread(fd, buf.data(), static_cast<size_t>(want), sent);
       if (n <= 0) {
         ok = false;
         break;
@@ -1660,9 +1815,15 @@ class Session {
   // Forward the upstream response to the client, teeing GET-200 bodies into
   // the store (de-chunked, content-encoding preserved — the legacy cache
   // model, CONTRIBUTING.md:76,116).
+  // pre_w/fill: the plain-GET single-flight path claims the store writer
+  // and registers fill progress BEFORE dialing upstream (handle_request);
+  // this streamer then feeds the fill's watermark as bytes land so
+  // attached sessions serve off the growing partial.
   bool stream_response(const RequestHead &req, ResponseHead &resp,
                        const std::string &uri, const std::string &key,
-                       bool cacheable, const std::string &auth_scope = "") {
+                       bool cacheable, const std::string &auth_scope = "",
+                       Writer *pre_w = nullptr,
+                       std::shared_ptr<FillState> fill = nullptr) {
     bool head_only = req.method == "HEAD" || resp.status == 204 ||
                      resp.status == 304 || (resp.status >= 100 && resp.status < 200);
     std::string te = lower(resp.headers.get("transfer-encoding"));
@@ -1721,12 +1882,47 @@ class Session {
         cacheable && lfs_redirect && head_only && content_len <= 0 &&
         p_->store_ && cc.find("no-store") == std::string::npos &&
         (cc.find("private") == std::string::npos || !auth_scope.empty());
+    auto finish_fill = [&](bool fill_ok) {
+      if (!fill) return;
+      {
+        std::lock_guard<std::mutex> g(fill->mu);
+        fill->done = true;
+        fill->ok = fill_ok;
+      }
+      fill->cv.notify_all();
+      {
+        std::lock_guard<Mutex> g(p_->fill_mu_);
+        auto it = p_->fills_.find(key);
+        if (it != p_->fills_.end() && it->second == fill)
+          p_->fills_.erase(it);
+      }
+      fill.reset();
+    };
+
     Writer *w = nullptr;
-    if (do_cache) {
+    if (pre_w) {
+      if (do_cache) {
+        w = pre_w;
+      } else {
+        // claimed the writer, but the response turned out uncacheable
+        // (non-200, no-store, …): release the claim, fail the fill so
+        // attached sessions fall back to their own upstream
+        pre_w->abort(true);
+        delete pre_w;
+        finish_fill(false);
+      }
+    } else if (do_cache) {
       std::string err;
       w = p_->store_->begin(key, false, &err);
       if (!w) do_cache = false;  // another writer active; just stream
     }
+    if (fill && w) {
+      // publish the total (sized plain bodies only — chunked stays -1
+      // and attachers wait for done) so attached readers can reply
+      std::lock_guard<std::mutex> g(fill->mu);
+      fill->total = (!chunked && content_len >= 0) ? content_len : -1;
+    }
+    if (fill) fill->cv.notify_all();
 
     // response head toward client
     std::string head = "HTTP/1.1 " + std::to_string(resp.status) + " " +
@@ -1745,7 +1941,11 @@ class Session {
       head += "Connection: keep-alive\r\n";
     head += "\r\n";
     if (!client_.write_all(head.data(), head.size())) {
-      if (w) w->abort(true);
+      if (w) {
+        w->abort(true);
+        delete w;
+      }
+      finish_fill(false);
       return false;
     }
 
@@ -1760,7 +1960,11 @@ class Session {
           delete hw;
         }
       }
-      if (w) w->abort(false);
+      if (w) {
+        w->abort(false);
+        delete w;
+      }
+      finish_fill(false);
       return true;
     }
 
@@ -1774,6 +1978,14 @@ class Session {
         delete w;
         w = nullptr;
         do_cache = false;
+        finish_fill(false);  // attached readers can't proceed either
+      }
+      if (fill && w) {
+        {
+          std::lock_guard<std::mutex> g(fill->mu);
+          fill->written = w->offset();
+        }
+        fill->cv.notify_all();
       }
       if (client_ok) {
         if (chunked) {
@@ -1855,7 +2067,9 @@ class Session {
         w->abort(true);  // keep partial for resume
         delete w;
       }
+      finish_fill(upstream_ok);
     }
+    finish_fill(false);  // leftover fill (writer was dropped mid-stream)
     if (until_close) return false;
     return client_ok && upstream_ok;
   }
@@ -2026,10 +2240,29 @@ class Session {
 
     // small-object fast path: coalesce header+body into one vectored write
     // — meta/config-sized blobs (and small ranges of big ones) leave as a
-    // single syscall/segment instead of a write(head)+sendfile pair
+    // single syscall/segment instead of a write(head)+sendfile pair. A
+    // hot-tier hit feeds the iovec straight from the pinned mapping
+    // (zero disk I/O, zero copy); a miss admits the object so the next
+    // hit is free, then falls back to pread.
     const int64_t kCoalesceMax = 256 << 10;
     if (!client_.ssl && req.method != "HEAD" && len > 0 &&
         len <= kCoalesceMax) {
+      int64_t hot_size = 0;
+      const char *hot = p_->store_->hot_acquire(key, &hot_size);
+      if (!hot && p_->store_->hot_admit(key))
+        hot = p_->store_->hot_acquire(key, &hot_size);
+      if (hot && hot_size >= off + len) {
+        route_ttfb();
+        bool ok = client_.writev_all(head.data(), head.size(), hot + off,
+                                     static_cast<size_t>(len));
+        p_->store_->hot_release(key);
+        if (!ok) return false;
+        log_response(req, uri, status, ct, len, true);
+        p_->metrics_.bytes_cache += static_cast<uint64_t>(len);
+        p_->metrics_.serve_bytes += static_cast<uint64_t>(len);
+        return true;
+      }
+      if (hot) p_->store_->hot_release(key);  // stale size: serve off disk
       std::vector<char> body(static_cast<size_t>(len));
       int64_t got = 0;
       while (got < len) {
@@ -2077,6 +2310,34 @@ class Session {
         ::close(fd);
         return ok;
       }
+    }
+    // SSL (and no-fd fallback) body loop: bytes must pass through
+    // SSL_write anyway, so a hot-tier mapping replaces the per-window
+    // pread syscall+copy — windows are written straight off the pinned
+    // mapping, eviction deferred to hot_release
+    {
+      int64_t hot_size = 0;
+      const char *hot = p_->store_->hot_acquire(key, &hot_size);
+      if (!hot && p_->store_->hot_admit(key))
+        hot = p_->store_->hot_acquire(key, &hot_size);
+      if (hot && hot_size >= off + len) {
+        int64_t sent = 0;
+        bool ok = true;
+        while (sent < len) {
+          size_t want = static_cast<size_t>(
+              std::min<int64_t>(len - sent, 1ll << 20));
+          if (!client_.write_all(hot + off + sent, want)) {
+            ok = false;
+            break;
+          }
+          sent += static_cast<int64_t>(want);
+          p_->metrics_.bytes_cache += want;
+          p_->metrics_.serve_bytes += want;
+        }
+        p_->store_->hot_release(key);
+        return ok;
+      }
+      if (hot) p_->store_->hot_release(key);
     }
     std::vector<char> buf(1 << 20);
     int64_t sent = 0;
@@ -2364,7 +2625,7 @@ std::string Proxy::statusz_json() {
   char buf[1024];
   ::snprintf(
       buf, sizeof buf,
-      "{\"statusz\":1,\"server\":\"demodel-native-proxy\","
+      "{\"statusz\":2,\"server\":\"demodel-native-proxy\","
       "\"start_time\":%.3f,\"uptime_sec\":%.3f,"
       "\"config\":{\"reactor\":%s,\"session_threads\":%d,"
       "\"max_conns\":%d,\"idle_timeout_sec\":%d,\"io_timeout_sec\":%d,"
@@ -2372,7 +2633,7 @@ std::string Proxy::statusz_json() {
       "\"conns\":{\"live\":%d,\"active\":%d,\"parked\":%zu,"
       "\"queue_depth\":%zu},"
       "\"restore_tensors\":%zu,\"fills_in_flight\":%zu,"
-      "\"digest_hints\":%zu,\"metrics\":",
+      "\"digest_hints\":%zu,",
       started_wall_, uptime, reactor_enabled_ ? "true" : "false",
       session_threads_, max_conns_, idle_timeout_sec_, cfg_.io_timeout_sec,
       cfg_.mitm_all ? "true" : "false", cfg_.no_mitm ? "true" : "false",
@@ -2380,6 +2641,25 @@ std::string Proxy::statusz_json() {
       live_sessions_.load() > 0 ? live_sessions_.load() : 0, parked,
       queue_depth, tensors, fills, hints);
   std::string out = buf;
+  // tier occupancy/budget — schema parity with the Python statusz
+  // `tiers` section (fills above are this plane's in-flight leaders)
+  if (store_) {
+    int64_t hobjs = 0, hbytes = 0, hmax = 0, hhits = 0, hmiss = 0, hev = 0;
+    store_->hot_stats(&hobjs, &hbytes, &hmax, &hhits, &hmiss, &hev);
+    char tbuf[512];
+    ::snprintf(tbuf, sizeof tbuf,
+               "\"tiers\":{\"ram\":{\"objects\":%lld,\"bytes\":%lld,"
+               "\"max_bytes\":%lld,\"hits\":%lld,\"misses\":%lld,"
+               "\"evicted_bytes\":%lld},"
+               "\"disk\":{\"max_bytes\":%lld}},",
+               (long long)hobjs, (long long)hbytes, (long long)hmax,
+               (long long)hhits, (long long)hmiss, (long long)hev,
+               (long long)cfg_.cache_max_bytes);
+    out.append(tbuf);
+  } else {
+    out.append("\"tiers\":null,");  // schema v2: the key is always present
+  }
+  out.append("\"metrics\":");
   out.append(metrics_json());
   out.append("}");
   return out;

@@ -9,6 +9,7 @@
 
 #include <arpa/inet.h>
 #include <csignal>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -997,6 +998,215 @@ static void test_peer_window_fetch(const std::string &root) {
   delete p;
 }
 
+// ---- mmap hot tier: digest-verified admit, LRU under the byte budget,
+// pinned-victim deferred munmap, invalidation on remove — the churn loop
+// is what ASan/TSan + DM_LOCK_ORDER_CHECK watch
+
+static void test_hot_tier(const std::string &root) {
+  ::setenv("DEMODEL_TIER_RAM_MB", "1", 1);  // 1 MiB budget
+  std::string err;
+  dm::Store *s = dm::Store::open(root + "/hotstore", &err);
+  ::unsetenv("DEMODEL_TIER_RAM_MB");
+  CHECK(s != nullptr, err.c_str());
+
+  auto mk = [&](const char *key, char seed) {
+    std::string b(400 << 10, '\0');
+    for (size_t i = 0; i < b.size(); i++) b[i] = (char)(seed + (i % 97));
+    CHECK(s->put(key, b.data(), (int64_t)b.size(), "{}", nullptr) == 0,
+          "hot put");
+    return b;
+  };
+  std::string a = mk("hotobj000000000a", 3);
+  std::string b = mk("hotobj000000000b", 5);
+  std::string c = mk("hotobj000000000c", 7);
+
+  CHECK(s->hot_admit("hotobj000000000a"), "admit a");
+  CHECK(s->hot_admit("hotobj000000000b"), "admit b");
+  int64_t n_obj = 0, n_bytes = 0, n_max = 0;
+  s->hot_stats(&n_obj, &n_bytes, &n_max, nullptr, nullptr, nullptr);
+  CHECK(n_obj == 2 && n_bytes == (800 << 10), "two admitted under budget");
+  CHECK(n_max == (1 << 20), "budget from DEMODEL_TIER_RAM_MB");
+
+  // serve off the mapping, bytes-exact, pin held across the next admit
+  int64_t sz = 0;
+  const char *m = s->hot_acquire("hotobj000000000a", &sz);
+  CHECK(m != nullptr && sz == (int64_t)a.size() &&
+            ::memcmp(m, a.data(), a.size()) == 0,
+        "acquire bytes");
+
+  // C pushes the tier over 1 MiB: the LRU victim (B — A was just used)
+  // must go, and the budget must hold while A's mapping stays pinned
+  CHECK(s->hot_admit("hotobj000000000c"), "admit c evicts lru");
+  s->hot_stats(&n_obj, &n_bytes, nullptr, nullptr, nullptr, nullptr);
+  CHECK(n_bytes <= (1 << 20), "budget respected after eviction");
+  CHECK(::memcmp(m, a.data(), a.size()) == 0, "pinned mapping stays valid");
+  s->hot_release("hotobj000000000a");
+
+  // digest refusal: flip a committed byte; re-admission must fail (the
+  // bytes no longer hash to the content address recorded at publish)
+  s->hot_invalidate("hotobj000000000c");
+  {
+    std::string p = root + "/hotstore/objects/hotobj000000000c";
+    int fd = ::open(p.c_str(), O_WRONLY);
+    CHECK(fd >= 0, "corrupt open");
+    char flip = (char)(c[0] ^ 0x5a);
+    CHECK(::pwrite(fd, &flip, 1, 0) == 1, "corrupt write");
+    ::close(fd);
+  }
+  CHECK(!s->hot_admit("hotobj000000000c"), "corrupt bytes refused");
+
+  // remove() demotes the RAM copy with the disk one
+  (void)s->hot_admit("hotobj000000000b");
+  CHECK(s->remove("hotobj000000000b") == 0, "remove");
+  CHECK(s->hot_acquire("hotobj000000000b", nullptr) == nullptr,
+        "removed key not hot");
+
+  // concurrent churn: acquire/touch/release racing admit + invalidate on
+  // a live key and a digest-refused key
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 6; t++) {
+    ts.emplace_back([&, t] {
+      const char *keys[2] = {"hotobj000000000a", "hotobj000000000c"};
+      for (int i = 0; i < 200; i++) {
+        const char *k = keys[(t + i) & 1];
+        if (i % 17 == 0) s->hot_invalidate(k);
+        if (i % 5 == 0) (void)s->hot_admit(k);
+        int64_t hsz = 0;
+        const char *hm = s->hot_acquire(k, &hsz);
+        if (hm) {
+          volatile char sink = hm[hsz - 1];  // touch the tail page
+          (void)sink;
+          s->hot_release(k);
+        }
+      }
+    });
+  }
+  for (auto &t : ts) t.join();
+  delete s;
+}
+
+// ---- forward-path single-flight: N concurrent cold GETs for one URI
+// through the proxy cost exactly ONE origin fetch; waiters stream
+// bytes-exact bodies off the leader's landing partial (FILL-ATTACH),
+// and a warm re-read is a pure cache hit
+
+static void test_single_flight(const std::string &root) {
+  // counting origin: one sized 200 body, stalled mid-body so the cohort
+  // genuinely overlaps the landing stream
+  std::string body(2u << 20, '\0');
+  for (size_t i = 0; i < body.size(); i++)
+    body[i] = (char)((i * 40503u + 17) >> 7);
+  std::atomic<int> origin_hits{0};
+  std::atomic<bool> origin_stop{false};
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in la = {};
+  la.sin_family = AF_INET;
+  la.sin_port = 0;
+  ::inet_pton(AF_INET, "127.0.0.1", &la.sin_addr);
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  CHECK(::bind(lfd, (struct sockaddr *)&la, sizeof la) == 0, "origin bind");
+  CHECK(::listen(lfd, 64) == 0, "origin listen");
+  socklen_t lalen = sizeof la;
+  ::getsockname(lfd, (struct sockaddr *)&la, &lalen);
+  int origin_port = ntohs(la.sin_port);
+  std::thread origin([&] {
+    for (;;) {
+      int cfd = ::accept(lfd, nullptr, nullptr);
+      if (cfd < 0) return;
+      if (origin_stop.load()) {
+        ::close(cfd);
+        return;
+      }
+      char rb[2048];
+      size_t got = 0;
+      while (got < sizeof rb - 1) {
+        ssize_t n = ::read(cfd, rb + got, sizeof rb - 1 - got);
+        if (n <= 0) break;
+        got += (size_t)n;
+        rb[got] = 0;
+        if (::strstr(rb, "\r\n\r\n")) break;
+      }
+      origin_hits++;
+      char head[256];
+      int hn = ::snprintf(head, sizeof head,
+                          "HTTP/1.1 200 OK\r\nContent-Length: %zu\r\n"
+                          "Content-Type: application/octet-stream\r\n"
+                          "Connection: close\r\n\r\n",
+                          body.size());
+      (void)!::write(cfd, head, (size_t)hn);
+      size_t half = body.size() / 2;
+      (void)!::write(cfd, body.data(), half);
+      // stall: every waiter must attach to the fill, not dial us
+      std::this_thread::sleep_for(std::chrono::milliseconds(600));
+      (void)!::write(cfd, body.data() + half, body.size() - half);
+      ::close(cfd);
+    }
+  });
+
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/sfstore";
+  cfg.verbose = false;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "sf proxy start");
+  int port = p->port();
+
+  // absolute-form GET through the plain port (forward-proxy shape)
+  auto fetch = [&](std::string *out) {
+    int fd = pool_connect(port);
+    if (fd < 0) return;
+    char req[256];
+    ::snprintf(req, sizeof req,
+               "GET http://127.0.0.1:%d/sfblob HTTP/1.1\r\n"
+               "Host: 127.0.0.1:%d\r\nConnection: close\r\n\r\n",
+               origin_port, origin_port);
+    if (::write(fd, req, ::strlen(req)) == (ssize_t)::strlen(req)) {
+      char buf[65536];
+      ssize_t n;
+      while ((n = ::read(fd, buf, sizeof buf)) > 0) out->append(buf, (size_t)n);
+    }
+    ::close(fd);
+  };
+
+  constexpr int kClients = 12;
+  std::string got[kClients];
+  std::vector<std::thread> cs;
+  for (int i = 0; i < kClients; i++)
+    cs.emplace_back([&, i] { fetch(&got[i]); });
+  for (auto &t : cs) t.join();
+
+  int ok_bodies = 0, attached = 0;
+  for (int i = 0; i < kClients; i++) {
+    auto he = got[i].find("\r\n\r\n");
+    if (he != std::string::npos &&
+        got[i].compare(0, 15, "HTTP/1.1 200 OK") == 0 &&
+        got[i].size() - (he + 4) == body.size() &&
+        ::memcmp(got[i].data() + he + 4, body.data(), body.size()) == 0)
+      ok_bodies++;
+    if (got[i].find("X-Demodel-Cache: FILL-ATTACH") != std::string::npos)
+      attached++;
+  }
+  CHECK(ok_bodies == kClients, "every client bytes-exact");
+  CHECK(origin_hits.load() == 1, "exactly one origin fetch");
+  CHECK(attached >= 1, "waiters attached to the landing stream");
+
+  // warm re-read: pure cache hit, origin untouched
+  std::string warm;
+  fetch(&warm);
+  CHECK(warm.find("X-Demodel-Cache: HIT") != std::string::npos, "warm hit");
+  CHECK(origin_hits.load() == 1, "no refetch on warm read");
+
+  p->stop();
+  delete p;
+  origin_stop = true;
+  int dfd = pool_connect(origin_port);  // wake the accept loop
+  if (dfd >= 0) ::close(dfd);
+  origin.join();
+  ::close(lfd);
+}
+
 int main() {
   // the data plane's raw sends carry MSG_NOSIGNAL, but OpenSSL's socket
   // BIO does not — a peer-closed TLS conn must surface as EPIPE/CHECK
@@ -1019,6 +1229,8 @@ int main() {
   test_statusz_endpoint(root);
   test_telemetry_endpoint(root);
   test_peer_window_fetch(root);
+  test_hot_tier(root);
+  test_single_flight(root);
   if (failures) {
     ::fprintf(stderr, "%d failures\n", failures);
     return 1;

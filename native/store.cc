@@ -1,6 +1,7 @@
 #include "store.h"
 
 #include <fcntl.h>
+#include <sys/mman.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <unistd.h>
@@ -296,6 +297,16 @@ Store *Store::open(const std::string &root, std::string *err) {
   }
   Store *s = new Store(root);
   s->hid_ = g_store_hid.fetch_add(1);
+  // host-RAM hot tier budget — same knob as the Python tier plane
+  // (DEMODEL_TIER_RAM_MB, default 256); <=0 disables the tier
+  long long mb = 256;
+  const char *env = ::getenv("DEMODEL_TIER_RAM_MB");
+  if (env && *env) {
+    char *end = nullptr;
+    long long v = ::strtoll(env, &end, 10);
+    if (end && *end == '\0') mb = v < 0 ? 0 : v;
+  }
+  s->hot_max_ = mb << 20;
   return s;
 }
 
@@ -308,9 +319,15 @@ Store::~Store() {
     for (auto &p : pinned_) ::unlink(pin_path(p.first).c_str());
     pinned_.clear();
   }
-  std::lock_guard<Mutex> g(fd_mu_);
-  for (auto &p : fd_cache_) ::close(p.second);
-  fd_cache_.clear();
+  {
+    std::lock_guard<Mutex> g(fd_mu_);
+    for (auto &p : fd_cache_) ::close(p.second);
+    fd_cache_.clear();
+  }
+  std::lock_guard<Mutex> g(hot_mu_);
+  for (auto &p : hot_)
+    if (p.second.map) ::munmap(p.second.map, (size_t)p.second.size);
+  hot_.clear();
 }
 
 std::string Store::obj_path(const std::string &key) const {
@@ -553,6 +570,7 @@ int Store::publish(const std::string &key, const std::string &meta_json,
       fd_cache_.erase(it);
     }
   }
+  hot_invalidate(key);  // a recommitted body makes the old mapping stale
   // content-address hardlink — PRIVATE (auth-scoped) objects stay out of
   // the digest map so cross-user dedup can never leak their bytes
   if (is_hex_digest(digest) && !meta_is_private(enriched)) {
@@ -596,6 +614,7 @@ int Store::remove(const std::string &key) {
       fd_cache_.erase(it);
     }
   }
+  hot_invalidate(key);
   invalidate_index();
   return rc;
 }
@@ -773,6 +792,7 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
         fd_cache_.erase(it);
       }
     }
+    hot_invalidate(en.key);  // disk eviction demotes the RAM copy too
     // bytes only come back when the LAST link to the inode goes away
     if (en.nlink <= 2) {  // objects/<key> + possibly digests/<sha>
       total -= en.size;
@@ -783,6 +803,140 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
   }
   invalidate_index();
   return total;
+}
+
+// --------------------------------------------------------- mmap hot tier
+//
+// Committed objects mapped read-only into host RAM, LRU under the
+// DEMODEL_TIER_RAM_MB budget the Python tier plane shares. Admission is
+// digest-verified (the mapped bytes must hash to the content address
+// recorded at publish), so a torn or tampered object is refused, never
+// served. hot_mu_ is the innermost leaf rank: it is never held across a
+// syscall that can block (mmap/munmap/hashing all happen outside it).
+
+const char *Store::hot_acquire(const std::string &key, int64_t *size_out) {
+  std::lock_guard<Mutex> g(hot_mu_);
+  auto it = hot_.find(key);
+  if (it == hot_.end() || it->second.dead) {
+    hot_misses_++;
+    return nullptr;
+  }
+  it->second.last_use = ++hot_tick_;
+  it->second.users++;
+  hot_hits_++;
+  if (size_out) *size_out = it->second.size;
+  return it->second.map;
+}
+
+void Store::hot_release(const std::string &key) {
+  char *unmap = nullptr;
+  int64_t unmap_len = 0;
+  {
+    std::lock_guard<Mutex> g(hot_mu_);
+    auto it = hot_.find(key);
+    if (it == hot_.end()) return;
+    if (--it->second.users == 0 && it->second.dead) {
+      unmap = it->second.map;
+      unmap_len = it->second.size;
+      hot_.erase(it);
+    }
+  }
+  if (unmap) ::munmap(unmap, (size_t)unmap_len);
+}
+
+bool Store::hot_admit(const std::string &key) {
+  if (hot_max_ <= 0) return false;
+  {
+    std::lock_guard<Mutex> g(hot_mu_);
+    auto it = hot_.find(key);
+    if (it != hot_.end()) return !it->second.dead;  // dead: still draining
+  }
+  int64_t sz = size(key);
+  if (sz <= 0 || sz > hot_max_) return false;  // one object must not own
+                                               // the whole tier
+  int fd = ::open(obj_path(key).c_str(), O_RDONLY | O_CLOEXEC);
+  if (fd < 0) return false;
+  void *m = ::mmap(nullptr, (size_t)sz, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);
+  if (m == MAP_FAILED) return false;
+  std::string want = meta_digest(meta(key));
+  if (!want.empty() && Sha256::hex_of(m, (size_t)sz) != want) {
+    ::munmap(m, (size_t)sz);
+    return false;  // bytes no longer match their content address
+  }
+  std::vector<std::pair<char *, int64_t>> unmaps;
+  {
+    std::lock_guard<Mutex> g(hot_mu_);
+    auto it = hot_.find(key);
+    if (it != hot_.end()) {  // lost an admit race; keep the first mapping
+      unmaps.emplace_back((char *)m, sz);
+    } else {
+      HotObj o;
+      o.map = (char *)m;
+      o.size = sz;
+      o.last_use = ++hot_tick_;
+      hot_.emplace(key, o);
+      hot_bytes_ += sz;
+      // LRU-evict to the budget; a pinned victim is marked dead (its
+      // munmap happens at the last hot_release), an idle one unmaps
+      // outside the lock
+      while (hot_bytes_ > hot_max_) {
+        auto victim = hot_.end();
+        for (auto jt = hot_.begin(); jt != hot_.end(); ++jt) {
+          if (jt->first == key || jt->second.dead) continue;
+          if (victim == hot_.end() ||
+              jt->second.last_use < victim->second.last_use)
+            victim = jt;
+        }
+        if (victim == hot_.end()) break;
+        hot_bytes_ -= victim->second.size;
+        hot_evicted_bytes_ += victim->second.size;
+        if (victim->second.users == 0) {
+          unmaps.emplace_back(victim->second.map, victim->second.size);
+          hot_.erase(victim);
+        } else {
+          victim->second.dead = true;
+        }
+      }
+    }
+  }
+  for (auto &u : unmaps) ::munmap(u.first, (size_t)u.second);
+  return true;
+}
+
+void Store::hot_invalidate(const std::string &key) {
+  char *unmap = nullptr;
+  int64_t unmap_len = 0;
+  {
+    std::lock_guard<Mutex> g(hot_mu_);
+    auto it = hot_.find(key);
+    if (it == hot_.end() || it->second.dead) return;
+    hot_bytes_ -= it->second.size;
+    hot_evicted_bytes_ += it->second.size;
+    if (it->second.users == 0) {
+      unmap = it->second.map;
+      unmap_len = it->second.size;
+      hot_.erase(it);
+    } else {
+      it->second.dead = true;  // drains via hot_release
+    }
+  }
+  if (unmap) ::munmap(unmap, (size_t)unmap_len);
+}
+
+void Store::hot_stats(int64_t *objects, int64_t *bytes, int64_t *max_bytes,
+                      int64_t *hits, int64_t *misses,
+                      int64_t *evicted_bytes) {
+  std::lock_guard<Mutex> g(hot_mu_);
+  int64_t n = 0;
+  for (auto &p : hot_)
+    if (!p.second.dead) n++;
+  if (objects) *objects = n;
+  if (bytes) *bytes = hot_bytes_;
+  if (max_bytes) *max_bytes = hot_max_;
+  if (hits) *hits = hot_hits_.load();
+  if (misses) *misses = hot_misses_.load();
+  if (evicted_bytes) *evicted_bytes = hot_evicted_bytes_.load();
 }
 
 std::string Store::pin_path(const std::string &key) const {

@@ -169,6 +169,20 @@ class Store {
   void pin(const std::string &key);
   void unpin(const std::string &key);
 
+  // -- mmap hot tier (host-RAM cache over committed objects) ------------
+  // LRU under the DEMODEL_TIER_RAM_MB byte budget, digest-verified on
+  // admit (bytes that no longer hash to the recorded content address are
+  // refused). hot_acquire pins a read-only mapping for the caller's
+  // serve (nullptr on miss) — the caller MUST hot_release(key) when the
+  // bytes have left; eviction of a pinned object defers the munmap to
+  // the last release. remove/publish/gc invalidate stale mappings.
+  const char *hot_acquire(const std::string &key, int64_t *size_out);
+  void hot_release(const std::string &key);
+  bool hot_admit(const std::string &key);
+  void hot_invalidate(const std::string &key);
+  void hot_stats(int64_t *objects, int64_t *bytes, int64_t *max_bytes,
+                 int64_t *hits, int64_t *misses, int64_t *evicted_bytes);
+
   // -- paths (used by writers and the proxy's fill-attach reader)
   std::string obj_path(const std::string &key) const;
   std::string meta_path(const std::string &key) const;
@@ -216,6 +230,23 @@ class Store {
 
   Mutex gc_mu_{kRankStoreGc};  // one GC pass at a time
   std::atomic<int64_t> evictions_total_{0};
+
+  // mmap hot tier: key → pinned read-only mapping. `users` counts
+  // in-flight serves off the mapping; `dead` marks an evicted entry
+  // whose munmap waits for the last hot_release.
+  struct HotObj {
+    char *map = nullptr;
+    int64_t size = 0;
+    uint64_t last_use = 0;
+    int users = 0;
+    bool dead = false;
+  };
+  Mutex hot_mu_{kRankStoreHot};
+  std::unordered_map<std::string, HotObj> hot_;
+  int64_t hot_bytes_ = 0;      // charged (live, non-dead) mapping bytes
+  int64_t hot_max_ = 0;        // DEMODEL_TIER_RAM_MB << 20 (0 = disabled)
+  uint64_t hot_tick_ = 0;      // LRU clock
+  std::atomic<int64_t> hot_hits_{0}, hot_misses_{0}, hot_evicted_bytes_{0};
 };
 
 // peer DCN fetch (implemented in proxy.cc — shares Conn/http plumbing)

@@ -1,0 +1,37 @@
+"""guarded-field handler-roots fixture: HTTP-handler-pool entries.
+
+``do_GET`` of a ``BaseHTTPRequestHandler`` subclass is a thread entry
+point with no submit edge in sight — ThreadingHTTPServer runs one FRESH
+handler instance per live connection, so the entry is multi-instance:
+the unguarded write in the shared board it calls into races ITSELF
+across two connections. The lock-guarded counter and the handler's OWN
+per-instance field are the controls that must stay silent.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler
+
+
+class FlightBoard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.waiters = 0
+        self.leaders = 0
+
+    def join(self):
+        self.waiters += 1            # WRITE, no lock — two connections tear it
+
+    def lead(self):
+        with self._lock:
+            self.leaders += 1        # guarded — silent
+
+
+class PullHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        board = FlightBoard()
+        board.join()
+        board.lead()
+        self.last_path = self.path   # own field: per-instance, silent
+
+    def do_POST(self):
+        self.last_path = "/"         # own-field write — still silent

@@ -144,6 +144,14 @@ GOLDEN = {
         ("guarded-field", 22),
         ("guarded-field", 24),
     },
+    # HTTP-handler-pool roots: do_* of a BaseHTTPRequestHandler subclass
+    # is a multi-instance thread entry (one fresh handler per connection)
+    # — the unguarded write in the board it calls into races itself; the
+    # guarded counter and the handler's OWN per-instance field (ownership
+    # exemption) stay silent
+    "handler_bad.py": {
+        ("guarded-field", 22),
+    },
     "snapshot_bad.py": {
         ("atomic-snapshot", 19),
         ("atomic-snapshot", 32),

@@ -380,9 +380,10 @@ def test_statusz_snapshot_sections():
     budget.release(200)
     with trace.span("pull"):
         doc = statusz.snapshot(extra={"server": "test"})
-    assert doc["statusz"] == 1
+    assert doc["statusz"] == 2
     assert doc["server"] == "test"
     assert doc["uptime_sec"] >= 0
+    assert isinstance(doc["tiers"], list)  # v2: tier section always present
     assert doc["breakers"]["http://dead:1"]["state"] == "open"
     assert doc["breakers"]["http://dead:1"]["open_age_sec"] >= 0
     (b,) = [x for x in doc["budgets"] if x["name"] == "test-budget"]
@@ -407,10 +408,13 @@ def test_native_statusz_endpoint(tmp_path):
         assert resp.status == 200
         doc = json.loads(resp.read())
         conn.close()
-        assert doc["statusz"] == 1
+        assert doc["statusz"] == 2
         assert doc["server"] == "demodel-native-proxy"
         assert doc["uptime_sec"] >= 0
         assert doc["conns"]["live"] >= 1  # the statusz conn itself
+        # v2 tier section: RAM occupancy/budget from the mmap hot tier
+        assert doc["tiers"]["ram"]["max_bytes"] > 0
+        assert doc["tiers"]["ram"]["bytes"] >= 0
         assert set(doc["config"]) >= {"reactor", "session_threads",
                                       "max_conns", "idle_timeout_sec"}
         assert "hist" in doc["metrics"]

@@ -1,0 +1,384 @@
+"""The tier plane: single-flight admission + mmap hot tier contracts.
+
+What is proven here, against REAL wire faults (tests/chaoshttp.py) where
+the contract is about failure:
+
+- a thundering herd of cold readers costs exactly ONE upstream fetch and
+  every member gets byte-exact results off the landing stream;
+- a leader dying mid-stream (RST) hands the flight to a waiter, which
+  RESUMES the partial with a ranged fetch — the origin never re-serves
+  the landed prefix, and the cohort still lands byte-exact;
+- a digest mismatch (corrupt origin bytes) fails the whole cohort
+  without committing the bytes and without poisoning the key — the next
+  read starts a fresh flight and succeeds;
+- promotion into the mmap hot tier is digest-verified (bytes that no
+  longer match their content address are refused, never served from
+  RAM) and the tier stays inside its byte budget by LRU demotion.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import requests
+
+from demodel_tpu import tier
+from demodel_tpu.store import Store
+from demodel_tpu.utils import metrics as m
+from demodel_tpu.utils.faults import DigestMismatch
+
+from .chaoshttp import ChaosPeer, FaultPlan, FaultSpec
+
+KEY = "tierblob00000001"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    m.HUB.reset()
+    yield
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = Store(tmp_path / "tier-store")
+    yield s
+    s.close()
+
+
+def _blob(mb: int = 4, seed: int = 7) -> bytes:
+    # deterministic, compressible-resistant body
+    one = bytes((i * 31 + seed) & 0xFF for i in range(1 << 20))
+    return one * mb
+
+
+class _RangeOrigin:
+    """A minimal Range-capable blob server — the REAL upstream behind
+    the chaos shim (the shim forwards Range headers verbatim)."""
+
+    def __init__(self, blobs: dict[str, bytes]):
+        outer_blobs = dict(blobs)
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: ARG002
+                pass
+
+            def do_GET(self):
+                body = outer_blobs.get(self.path)
+                if body is None:
+                    payload = b'{"error":"not found"}'
+                    self.send_response(404)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                rng = self.headers.get("Range", "")
+                start = 0
+                if rng.startswith("bytes="):
+                    start = int(rng[6:].split("-", 1)[0] or 0)
+                part = body[start:]
+                self.send_response(206 if start else 200)
+                if start:
+                    self.send_header(
+                        "Content-Range",
+                        f"bytes {start}-{len(body) - 1}/{len(body)}")
+                self.send_header("Accept-Ranges", "bytes")
+                self.send_header("Content-Length", str(len(part)))
+                self.end_headers()
+                self.wfile.write(part)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._srv.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._srv.server_address[1]}"
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self) -> "_RangeOrigin":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _http_fetch(base_url: str):
+    """A ``fetch(key, offset)`` upstream doing ranged GETs — what the
+    delivery plane's origin/peer fetchers look like to the tier."""
+
+    def fetch(key: str, offset: int):
+        headers = {"Connection": "close"}
+        if offset:
+            headers["Range"] = f"bytes={offset}-"
+        r = requests.get(f"{base_url}/{key}", headers=headers,
+                         stream=True, timeout=30)
+        if r.status_code not in (200, 206):
+            raise OSError(f"origin status {r.status_code}")
+        if offset and r.status_code != 206:
+            raise OSError("origin ignored Range")
+        yield from r.iter_content(256 << 10)
+
+    return fetch
+
+
+def _herd(ts: tier.TieredStore, fetch, n: int, digest: str | None = None,
+          timeout: float = 60.0):
+    """Barrier-release ``n`` concurrent reads; returns (results, errors)
+    index-aligned."""
+    gate = threading.Barrier(n)
+    results: list = [None] * n
+    errors: list = [None] * n
+
+    def client(i: int) -> None:
+        try:
+            gate.wait(timeout=30)
+            results[i] = ts.read(KEY, fetch=fetch, expected_digest=digest,
+                                 timeout=timeout)
+        except BaseException as e:  # noqa: BLE001 — asserted by callers
+            errors[i] = e
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors
+
+
+# ---------------------------------------------------------------- herd
+
+
+def test_herd_one_fetch_bytes_exact(store):
+    """N cold readers, one counting upstream: exactly one fetch, every
+    reader byte-exact (waiters ride the landing stream), and the object
+    lands hot."""
+    body = _blob(2)
+    calls = []
+
+    def fetch(key, offset):
+        calls.append((key, offset))
+        for i in range(offset, len(body), 256 << 10):
+            yield body[i:i + (256 << 10)]
+
+    ts = tier.TieredStore(store, name="t-herd")
+    try:
+        results, errors = _herd(ts, fetch, 8)
+        assert errors == [None] * 8, errors
+        assert all(r == body for r in results)
+        assert calls == [(KEY, 0)]
+        snap = m.HUB.snapshot()
+        assert snap.get("singleflight_leaders_total") == 1
+        assert snap.get("singleflight_waiters_total") == 7
+        # committed + promoted: the next read is a RAM hit
+        assert store.has(KEY)
+        assert ts.hot.contains(KEY)
+        assert ts.read(KEY) == body
+    finally:
+        ts.close()
+
+
+def test_herd_leader_death_waiter_takeover_resumes(store):
+    """A truncated stream kills the leader's fetch mid-body: the leader
+    gets its own wire error back (retry is the caller's), a waiter takes
+    the flight over and RESUMES the partial with a ranged fetch — two
+    origin requests total, the second carrying Range from the watermark,
+    the landed prefix never crossing the wire twice, and every WAITER
+    still lands byte-exact."""
+    body = _blob(4)
+    cut = 2_000_000
+    plan = FaultPlan(
+        FaultSpec("truncate", path=KEY, at_byte=cut, min_body=1 << 20),
+        seed=3)
+    with _RangeOrigin({f"/{KEY}": body}) as origin, \
+            ChaosPeer(origin.url, plan) as chaos:
+        ts = tier.TieredStore(store, name="t-takeover")
+        try:
+            results, errors = _herd(ts, _http_fetch(chaos.url), 4,
+                                    timeout=30.0)
+            assert plan.fired("truncate") == 1
+            # exactly one caller — the original leader — surfaces the
+            # wire error; the three others ride the handed-off flight
+            wire_errs = [e for e in errors if e is not None]
+            assert len(wire_errs) == 1, errors
+            assert not isinstance(wire_errs[0], DigestMismatch)
+            good = [r for r in results if r is not None]
+            assert len(good) == 3 and all(r == body for r in good)
+            # resume, not redo: the second request is ranged from the
+            # watermark, so the landed prefix never crosses the wire twice
+            ranged = [rng for _p, rng in chaos.requests_log if rng]
+            assert len(chaos.requests_log) == 2, chaos.requests_log
+            assert len(ranged) == 1 and ranged[0].startswith("bytes=")
+            resume_at = int(ranged[0][6:].rstrip("-"))
+            assert 0 < resume_at <= cut
+            assert chaos.bytes_served <= len(body) + (cut - resume_at)
+            assert m.HUB.snapshot().get("singleflight_handoffs_total") == 1
+            assert store.has(KEY)
+            assert ts.read(KEY) == body  # and the failed caller's retry
+        finally:                         # is now a disk/RAM hit
+            ts.close()
+
+
+def test_digest_mismatch_fails_cohort_without_poisoning(store):
+    """Corrupt origin bytes: the digest gate fails the WHOLE cohort, the
+    partial is dropped (resuming wrong bytes would re-fail every
+    successor), nothing is committed — and the key is not poisoned: the
+    next read starts a fresh flight and succeeds."""
+    body = _blob(2)
+    digest = hashlib.sha256(body).hexdigest()
+    plan = FaultPlan(
+        FaultSpec("corrupt", path=KEY, at_byte=512_000, min_body=1 << 20),
+        seed=5)
+    with _RangeOrigin({f"/{KEY}": body}) as origin, \
+            ChaosPeer(origin.url, plan) as chaos:
+        ts = tier.TieredStore(store, name="t-digest")
+        try:
+            results, errors = _herd(ts, _http_fetch(chaos.url), 3,
+                                    digest=digest, timeout=30.0)
+            assert plan.fired("corrupt") == 1
+            assert results == [None] * 3
+            assert all(isinstance(e, DigestMismatch) for e in errors), errors
+            assert not store.has(KEY)
+            assert not os.path.exists(
+                os.path.join(str(store.root), "partial", KEY))
+            # unpoisoned: the fault is spent, a fresh read lands clean
+            got = ts.read(KEY, fetch=_http_fetch(chaos.url),
+                          expected_digest=digest)
+            assert got == body
+            assert store.has(KEY)
+        finally:
+            ts.close()
+
+
+# ------------------------------------------------------------ hot tier
+
+
+def test_promotion_is_digest_verified(store):
+    """Bytes that stop matching their content address are refused RAM:
+    corrupting the on-disk object in place makes the next promotion fail
+    (the mapped bytes hash to a digest the store never recorded)."""
+    body = _blob(1)
+    store.put(KEY, body, {"content-type": "application/octet-stream"})
+    ts = tier.TieredStore(store, name="t-verify")
+    try:
+        assert ts.read(KEY) == body
+        assert ts.hot.contains(KEY)
+        ts.hot.invalidate(KEY)
+        assert not ts.hot.contains(KEY)
+        # flip one byte in place — same inode, wrong bytes
+        path = os.path.join(str(store.root), "objects", KEY)
+        fd = os.open(path, os.O_WRONLY)
+        try:
+            os.pwrite(fd, bytes([body[4096] ^ 0xFF]), 4096)
+        finally:
+            os.close(fd)
+        assert ts.hot.promote(KEY) is False
+        assert not ts.hot.contains(KEY)
+    finally:
+        ts.close()
+
+
+def test_hot_tier_budget_bounded_lru(store):
+    """The RAM tier never exceeds its byte budget: admitting past it
+    demotes the least-recently-used mapping, and an object larger than
+    the whole budget is refused outright."""
+    one_mb = 1 << 20
+    blob = _blob(1)[: 400 << 10]
+    keys = ["lrukey000000000a", "lrukey000000000b", "lrukey000000000c"]
+    for k in keys:
+        store.put(k, blob, {"content-type": "application/octet-stream"})
+    budget = tier.TierBudget("test-ram", one_mb)
+    ts = tier.TieredStore(store, hot_budget=budget, name="t-lru")
+    try:
+        assert ts.hot.promote(keys[0])
+        assert ts.hot.promote(keys[1])
+        assert budget.over() == 0
+        assert ts.hot.contains(keys[0]) and ts.hot.contains(keys[1])
+        # a is older than b → admitting c demotes a
+        assert ts.hot.promote(keys[2])
+        assert budget.over() == 0
+        assert not ts.hot.contains(keys[0])
+        assert ts.hot.contains(keys[1]) and ts.hot.contains(keys[2])
+        evicted = m.HUB.snapshot().get(
+            m.labeled("store_tier_evicted_bytes_total", tier="ram"), 0)
+        assert evicted >= len(blob)
+        # an object bigger than the WHOLE budget never maps
+        big = "lrukey000000000d"
+        store.put(big, _blob(2)[: one_mb + 1],
+                  {"content-type": "application/octet-stream"})
+        assert ts.hot.promote(big) is False
+    finally:
+        ts.close()
+
+
+def test_hot_reads_are_bytes_exact_copies(store):
+    """A hot read returns a COPY of the mapped bytes — exact against the
+    store, and still valid after the mapping is invalidated."""
+    body = _blob(1)
+    store.put(KEY, body, {"content-type": "application/octet-stream"})
+    ts = tier.TieredStore(store, name="t-copy")
+    try:
+        first = ts.read(KEY)
+        assert ts.hot.contains(KEY)
+        second = ts.read(KEY)  # served from RAM
+        ts.hot.invalidate(KEY)
+        assert first == body and second == body
+        snap = m.HUB.snapshot()
+        assert snap.get(m.labeled("store_tier_hits_total", tier="ram"),
+                        0) >= 1
+    finally:
+        ts.close()
+
+
+# ---------------------------------------------------- generic collapse
+
+
+def test_singleflight_do_collapses_and_hands_off(store):
+    """``SingleFlight.do``: one leader runs the work; a failed leader
+    hands the call to a waiter (the retry is ``fn`` again); waiters whose
+    leader succeeded get None (they re-read the store)."""
+    sf = tier.SingleFlight()
+    calls = []
+    gate = threading.Barrier(3)
+
+    def work():
+        calls.append(threading.get_ident())
+        if len(calls) == 1:
+            # hold the flight open until both waiters are queued, so the
+            # failure provably hands off instead of finishing unobserved
+            for _ in range(400):
+                d = sf.describe()
+                if d and d[0]["waiters"] >= 2:
+                    break
+                time.sleep(0.005)
+            raise OSError("leader dies")
+        return "landed"
+
+    outcomes: list = [None] * 3
+
+    def run(i):
+        gate.wait(timeout=10)
+        try:
+            outcomes[i] = sf.do("dokey", work, timeout=20)
+        except BaseException as e:  # noqa: BLE001 — asserted below
+            outcomes[i] = e
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exactly one retry after the handoff: the failed leader gets its
+    # own error back, the taking-over waiter gets the result, the last
+    # waiter gets None (re-read the store) — never the leader's error
+    assert len(calls) == 2
+    assert sum(1 for o in outcomes if isinstance(o, OSError)) == 1
+    assert sum(1 for o in outcomes if o == "landed") == 1
+    assert sum(1 for o in outcomes if o is None) == 1
+    assert sf.in_flight() == 0

@@ -226,6 +226,11 @@ class ProjectIndex:
         self.by_node: dict[int, FunctionInfo] = {}
         #: class qname → {method name → function qname}
         self.classes: dict[str, dict[str, str]] = {}
+        #: class qname → resolved base-class names (project classes keep
+        #: their qname; stdlib bases resolve through the alias table to
+        #: e.g. ``http.server.BaseHTTPRequestHandler``) — what lets the
+        #: guarded-field pass recognize HTTP-handler-pool entry points
+        self.class_bases: dict[str, list[str]] = {}
         #: class qname → {attr name → class qname} for constructor-assigned
         #: attributes (``self.x = KnownClass(...)``) — what lets
         #: ``self.x.m()`` resolve through the call graph
@@ -282,6 +287,10 @@ class ProjectIndex:
             elif isinstance(node, ast.ClassDef):
                 qname, _ = self._qname_of(ctx, node)
                 self.classes.setdefault(qname, {})
+                self.class_bases[qname] = [
+                    b for b in (self._resolve_name(ctx, dotted(base) or "")
+                                for base in node.bases)
+                    if b is not None]
 
     @staticmethod
     def _qname_of(ctx: "ModuleContext", node: ast.AST):

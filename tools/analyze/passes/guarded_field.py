@@ -22,9 +22,17 @@ absence of that shape compositionally, Infer/RacerD-style:
 3. **Concurrency evidence** — a method is *worker-escaping* when any
    ``FunctionInfo.submit_calls`` edge anywhere in the run (``ex.submit``
    / ``Thread(target=…)`` / ``asyncio.to_thread``, any module) resolves
-   to it, or when it is call-graph-reachable from such a method. No
-   evidence → no findings for the class (no-speculative-edges: a class
-   nothing submits is not assumed concurrent).
+   to it, when it is an HTTP-handler-pool entry point (a ``do_*`` method
+   of a ``BaseHTTPRequestHandler``-derived class — ThreadingHTTPServer
+   runs one handler instance per live connection, so these entries are
+   inherently multi-instance), or when it is call-graph-reachable from
+   such a method. No evidence → no findings for the class
+   (no-speculative-edges: a class nothing submits is not assumed
+   concurrent). Handler entries carry an ownership exemption: each
+   connection gets a FRESH handler instance confined to its pool thread,
+   so accesses to the handler class's OWN fields do not race through its
+   own entries — only the shared state its handlers call into (registry,
+   store, the single-flight waiter map) does.
 4. **Race check** — per field: a WRITE site and any other access site,
    at least one of them on a worker-escaping path, with DISJOINT
    effective lock sets, is a race finding; the blame names both sites
@@ -39,6 +47,7 @@ and bound-method references are not data fields.
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass, field
 from typing import Iterator
 
@@ -71,6 +80,15 @@ _MUTATORS = frozenset({
 #: API, and its internal locking is its own rule surface)
 _CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
                     "OrderedDict", "Counter"}
+
+
+#: stdlib request-handler bases whose subclasses the serving framework
+#: instantiates ONCE PER CONNECTION on a pool thread — their ``do_*``
+#: methods are thread entry points with no submit edge in sight
+_HANDLER_BASE_RE = re.compile(
+    r"(?:^|\.)(?:BaseHTTPRequestHandler|SimpleHTTPRequestHandler"
+    r"|CGIHTTPRequestHandler|BaseRequestHandler|StreamRequestHandler"
+    r"|DatagramRequestHandler)$")
 
 
 def container_attrs(cls_node: ast.ClassDef) -> set[str]:
@@ -241,12 +259,13 @@ def _held_locks(node: ast.AST, ctx: ModuleContext, fn: ast.AST,
 @register
 class GuardedFieldPass(Pass):
     id = "guarded-field"
-    version = "1"
+    version = "2"
     description = (
         "RacerD-style lock-set analysis: a field written on a "
-        "worker-escaping path (ex.submit/Thread(target)) and accessed "
-        "elsewhere with a disjoint lock set is a data race — both sites "
-        "and the submit edge land in the blame"
+        "worker-escaping path (ex.submit/Thread(target), or an HTTP "
+        "handler-pool do_* entry point) and accessed elsewhere with a "
+        "disjoint lock set is a data race — both sites and the "
+        "submit/entry edge land in the blame"
     )
 
     #: caller-lock / reachability composition bound (matches the index's
@@ -388,6 +407,29 @@ class GuardedFieldPass(Pass):
                     entries[q] = [info.rel, node.lineno, multi]
                 else:
                     prev[2] = True  # second submit site → multi-instance
+        # HTTP-handler-pool roots: every do_* method of a request-handler
+        # subclass is an entry the serving framework calls on a pool
+        # thread, one FRESH instance per live connection — inherently
+        # multi-instance. ``confined`` records the owning handler class:
+        # the instance itself is thread-confined, so the handler's OWN
+        # fields are exempt from racing through these entries (ownership)
+        # while everything the handler calls into keeps the root.
+        confined: dict[str, str] = {}
+        for cq in idx.classes:
+            if not self._is_handler_class(cq):
+                continue
+            for mname, mq in idx.classes.get(cq, {}).items():
+                if not mname.startswith("do_"):
+                    continue
+                m_info = idx.functions.get(mq)
+                if m_info is None:
+                    continue
+                prev = entries.get(mq)
+                if prev is None:
+                    entries[mq] = [m_info.rel, m_info.node.lineno, True]
+                else:
+                    prev[2] = True
+                confined[mq] = cq
         #: method qname → set of entry qnames it can run under
         roots: dict[str, set[str]] = {q: {q} for q in entries}
         frontier = list(entries)
@@ -451,7 +493,7 @@ class GuardedFieldPass(Pass):
             if not writes:
                 continue  # immutable after __init__ (init sites excluded)
             pair = self._racing_pair(writes, sites, roots, main_capable,
-                                     entries)
+                                     entries, confined)
             if pair is None or (cls, attr) in reported:
                 continue
             reported.add((cls, attr))
@@ -468,16 +510,23 @@ class GuardedFieldPass(Pass):
                 "interleaving tears this field",
             )
 
-    def _racing_pair(self, writes, sites, roots, main_capable, entries):
+    def _racing_pair(self, writes, sites, roots, main_capable, entries,
+                     confined):
         """First (write, other-access, submit-site) with disjoint locks
         that can execute on two DIFFERENT threads: distinct worker
-        entries, worker vs main, or one multi-instance worker entry."""
+        entries, worker vs main, or one multi-instance worker entry.
+        A handler entry confined to the access's own class is dropped
+        from that access's root set (per-connection handler instances
+        are thread-confined — their own fields never race through their
+        own entries)."""
         for w in sorted(writes, key=lambda s: (s.rel, s.line)):
-            wr = roots.get(w.method, set())
+            wr = {e for e in roots.get(w.method, set())
+                  if confined.get(e) != w.cls}
             wm = w.method in main_capable
             for a in sorted(sites, key=lambda s: (s.rel, s.line)):
                 same_site = (a.rel, a.line) == (w.rel, w.line)
-                ar = roots.get(a.method, set())
+                ar = {e for e in roots.get(a.method, set())
+                      if confined.get(e) != a.cls}
                 am = a.method in main_capable
                 if not wr and not ar:
                     continue  # no worker evidence on either side
@@ -497,6 +546,23 @@ class GuardedFieldPass(Pass):
                     continue
                 return w, a, evidence
         return None
+
+    def _is_handler_class(self, cq: str) -> bool:
+        """Does ``cq`` derive (transitively through project classes) from
+        a stdlib request-handler base?"""
+        idx = self.index
+        memo: dict[str, bool] = {}
+
+        def walk(q: str) -> bool:
+            if q in memo:
+                return memo[q]
+            memo[q] = False  # cycle guard
+            out = any(_HANDLER_BASE_RE.search(b) or walk(b)
+                      for b in idx.class_bases.get(q, ()))
+            memo[q] = out
+            return out
+
+        return walk(cq)
 
     @staticmethod
     def _concurrent(wr, wm, ar, am, entries):

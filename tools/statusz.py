@@ -211,7 +211,8 @@ def validate(doc: dict, source: str) -> None:
         if not native and "windows_s" not in doc.get("windows", {}):
             raise SystemExit(f"{source}: telemetry missing windows_s")
         return
-    if doc.get("statusz") != 1:
+    version = doc.get("statusz")
+    if version not in (1, 2):
         raise SystemExit(f"{source}: missing/unknown statusz schema version")
     native = doc.get("server") == "demodel-native-proxy"
     required = (("config", "conns", "metrics") if native else
@@ -220,6 +221,10 @@ def validate(doc: dict, source: str) -> None:
     for key in required:
         if key not in doc:
             raise SystemExit(f"{source}: statusz missing {key!r}")
+    if version >= 2 and "tiers" not in doc:
+        # v2 promise on BOTH planes: tier occupancy/budget is reportable
+        # (null on a native proxy running without a store)
+        raise SystemExit(f"{source}: statusz v2 missing 'tiers'")
     if native and "hist" not in doc["metrics"]:
         raise SystemExit(f"{source}: native metrics missing histograms")
     if not native:
