@@ -35,6 +35,7 @@ NATIVE_LOCK_RANKS = {
     "kRankProxyHint": 18,
     "kRankProxyRestore": 20,
     "kRankProxyTelemetry": 22,
+    "kRankProxyProfile": 24,
     "kRankStoreGc": 30,
     "kRankStoreWriters": 32,
     "kRankStoreIndex": 34,

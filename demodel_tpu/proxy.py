@@ -136,6 +136,10 @@ class ProxyServer:
         L.dm_proxy_free.restype = None
         L.dm_proxy_metrics.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
         L.dm_proxy_metrics.restype = c.c_int
+        L.dm_proxy_profile.argtypes = [
+            c.c_void_p, c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_int,
+        ]
+        L.dm_proxy_profile.restype = c.c_int
         L.dm_proxy_register_tensor.argtypes = [
             c.c_void_p, c.c_char_p, c.c_char_p, c.c_int64, c.c_int64,
         ]
@@ -197,6 +201,33 @@ class ProxyServer:
             if n < cap:
                 return json.loads(buf.value.decode())
             cap = n + 1
+
+    def profile(self, seconds: float = 1.0, hz: int = 0,
+                fmt: str = "json") -> dict | str | None:
+        """Capture a native-plane profile window.
+
+        Blocks for ``seconds`` (clamped to 5 by the native side) while the
+        in-process sampler accumulates, then returns the delta as a dict
+        (``fmt="json"``) or a Brendan-Gregg collapsed string
+        (``fmt="collapsed"``). ``None`` means the profiler is disabled
+        (``DEMODEL_OBS=0``) — the same contract as ``profiler.capture``.
+        """
+        collapsed = 1 if fmt == "collapsed" else 0
+        # the native side bounds the document (top 256 stacks + rollup),
+        # so 1 MB always suffices; the retry mirrors metrics() anyway in
+        # case that bound ever moves
+        cap = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.dm_proxy_profile(
+                self._h, int(seconds * 1000), hz, collapsed, buf, cap)
+            if n == 0:
+                return None
+            if n < cap:
+                text = buf.value.decode()
+                return text if collapsed else json.loads(text)
+            cap = n + 1
+            seconds = 0.0  # the window already happened; re-read cumulative
 
     def wait(self) -> None:
         self._stop_evt.wait()

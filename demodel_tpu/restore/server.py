@@ -508,6 +508,41 @@ def make_handler(registry: RestoreRegistry, proxy=None):
                 doc["server"] = "restore"
                 self._send(200, json.dumps(doc, default=str).encode())
                 return
+            if self.path.startswith("/debug/profile"):
+                # the continuous profiler: ?seconds= captures a windowed
+                # diff of the always-on aggregate (0 = cumulative), ?hz=
+                # temporarily raises the rate, ?format=collapsed|json.
+                # utils.profiler is stdlib-only, so a direct import keeps
+                # the node dep-light; DEMODEL_OBS=0 → 503 (tier is off).
+                from urllib.parse import parse_qs, urlsplit
+
+                from demodel_tpu.utils import profiler
+
+                q = parse_qs(urlsplit(self.path).query)
+
+                def _qp(key, default, cast):
+                    v = q.get(key, [None])[0]
+                    try:
+                        return cast(v) if v else default
+                    except ValueError:
+                        return default
+
+                seconds = _qp("seconds", 1.0, float)
+                hz = _qp("hz", 0, int)
+                fmt = _qp("format", "json", str)
+                prof = profiler.capture(seconds=seconds, hz=hz)
+                if prof is None:
+                    self._send(503, b'{"error":"profiler disabled '
+                                    b'(DEMODEL_OBS=0)"}')
+                    return
+                prof["server"] = "restore"
+                if fmt == "collapsed":
+                    self._send(200, profiler.collapse(prof).encode(),
+                               ctype="text/plain; charset=utf-8")
+                else:
+                    self._send(200,
+                               json.dumps(prof, default=str).encode())
+                return
             if self.path == "/debug/statusz":
                 # live introspection: open breakers, budget charge,
                 # in-flight span tree, flight-recorder state — "what is
@@ -629,6 +664,12 @@ class RestoreServer:
             from demodel_tpu.utils import retention
 
             retention.ensure(proxy=self._proxy)
+        # the continuous profiler is always-on at the observe tier (a
+        # serving node must be profilable from curl without a restart);
+        # DEMODEL_OBS=0 makes this a no-op — no thread ever starts
+        from demodel_tpu.utils import profiler
+
+        profiler.ensure()
         log.info("restore API listening on :%d", self.port)
         return self
 

@@ -154,3 +154,24 @@ def telemetry_retain_hours() -> int:
     """``DEMODEL_TELEMETRY_RETAIN_HOURS``: age budget for archived
     telemetry segments (default three days of history)."""
     return env_int("DEMODEL_TELEMETRY_RETAIN_HOURS", 72, minimum=1)
+
+
+def profile_hz() -> int:
+    """``DEMODEL_PROFILE_HZ``: sampling rate of the continuous profiler
+    (default 19 — deliberately off the common 10/100 Hz beat so periodic
+    work at round rates doesn't alias into or out of the profile)."""
+    return env_int("DEMODEL_PROFILE_HZ", 19, minimum=1)
+
+
+def profile_max_stacks() -> int:
+    """``DEMODEL_PROFILE_MAX_STACKS``: bound on distinct folded stacks
+    the profiler aggregates; past it new stacks fold into ``(other)`` and
+    a drop counter — the aggregate must stay bounded on any workload."""
+    return env_int("DEMODEL_PROFILE_MAX_STACKS", 2048, minimum=16)
+
+
+def profile_window_s() -> int:
+    """``DEMODEL_PROFILE_WINDOW_S``: seconds per profile window rolled
+    into the telemetry archive (Python plane only — the native sampler
+    exports cumulative aggregates and the restore server windows them)."""
+    return env_int("DEMODEL_PROFILE_WINDOW_S", 60, minimum=5)

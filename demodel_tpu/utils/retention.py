@@ -265,6 +265,20 @@ class TelemetryArchive:
             rec = _window_record(name, prev, cur)
             if rec is not None:
                 pending.append(rec)
+        # the profiler plane rides the same flush cadence: drain any
+        # rolled profile windows into the archive (sys.modules peek — the
+        # flusher must not be the thing that imports, let alone starts,
+        # the sampler). kind="profile" records carry no counters/gauges/
+        # hists keys, so history() skips them by design; profiles() reads
+        # them back.
+        import sys as _sys
+
+        prof = _sys.modules.get("demodel_tpu.utils.profiler")
+        if prof is not None:
+            try:
+                pending.extend(prof.drain_windows())
+            except Exception:
+                log.exception("profile window drain failed")
         for rec in pending:
             self.append(rec)
         return len(pending)
@@ -376,6 +390,30 @@ class TelemetryArchive:
             "incarnations": len(pids),
             "series": series,
         }
+
+    def profiles(self, since: float | None = None,
+                 until: float | None = None,
+                 plane: str | None = None) -> list[dict[str, Any]]:
+        """The archived profile windows (``kind="profile"`` records the
+        flusher drained from the sampler), in segment order — spanning
+        every incarnation whose segments survived retention, same as
+        :meth:`history`. These records carry ``stacks`` instead of
+        counters/gauges/hists, so :meth:`history` skips them and this is
+        their dedicated reader (``tools/profile_report.py --archive``)."""
+        out: list[dict[str, Any]] = []
+        for rec in self.records():
+            if rec.get("kind") != "profile":
+                continue
+            ts = rec.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            if (since is not None and ts < since) \
+                    or (until is not None and ts > until):
+                continue
+            if plane is not None and rec.get("plane") != plane:
+                continue
+            out.append(rec)
+        return out
 
     def describe(self) -> dict[str, Any]:
         segs = self.segments()

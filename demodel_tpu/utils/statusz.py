@@ -149,6 +149,9 @@ def _knob_rows() -> list[tuple[str, Any]]:
         ("DEMODEL_TELEMETRY_ARCHIVE", env.telemetry_archive_dir() or "off"),
         ("DEMODEL_TELEMETRY_RETAIN_MB", env.telemetry_retain_mb()),
         ("DEMODEL_TELEMETRY_RETAIN_HOURS", env.telemetry_retain_hours()),
+        ("DEMODEL_PROFILE_HZ", env.profile_hz()),
+        ("DEMODEL_PROFILE_MAX_STACKS", env.profile_max_stacks()),
+        ("DEMODEL_PROFILE_WINDOW_S", env.profile_window_s()),
     ]
 
 
@@ -177,6 +180,16 @@ def effective_config() -> dict[str, dict[str, Any]]:
         if attr is not None and attr in snap:
             value, source = snap[attr], "tuner"
         out[env_var] = {"value": value, "source": source}
+    return out
+
+
+def _profiler() -> dict[str, Any] | None:
+    """The continuous profiler's live counters (sys.modules peek — a
+    scrape must never be what starts the sampler thread)."""
+    prof = sys.modules.get("demodel_tpu.utils.profiler")
+    if prof is None:
+        return None
+    out: dict[str, Any] | None = prof.describe()
     return out
 
 
@@ -223,6 +236,7 @@ def snapshot(extra: dict[str, Any] | None = None) -> dict[str, Any]:
         "tiers": _tiers(),
         "gossip": _gossip(),
         "config": effective_config(),
+        "profiler": _profiler(),
         "telemetry": _telemetry_summary(),
         "counters": metrics.HUB.snapshot(),
         "gauges": metrics.HUB.gauges(),

@@ -364,6 +364,21 @@ def dump_recorder(reason: str, path: str | None = None) -> str:
         "spans": st.recorder.snapshot(),
         "inflight": inflight(),
     }
+    # one signal, spans AND frames: embed the profiler's last rolled
+    # window (or its live aggregate) when the profiler plane is loaded.
+    # sys.modules peek, same dep-light stance as the statusz sections —
+    # a recorder dump must never be the thing that imports the profiler.
+    import sys as _sys
+
+    prof = _sys.modules.get("demodel_tpu.utils.profiler")
+    if prof is not None:
+        try:
+            window = prof.recorder_window()
+            if window is not None:
+                doc["profile"] = window
+        except Exception as e:  # noqa: BLE001 — post-mortem must still
+            # land even if the profiler misbehaves; record why it is bare
+            doc["profile_error"] = str(e)
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f, separators=(",", ":"), default=str)
     st.last_dump = path
@@ -443,8 +458,8 @@ class Span:
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
                  "events", "status", "error", "_t0", "_wall0", "dur",
-                 "_token", "_thread_name", "_suppress_export",
-                 "_unsampled_token")
+                 "_token", "_thread_name", "_thread_ident",
+                 "_suppress_export", "_unsampled_token")
 
     def __init__(self, name: str, trace_id: str, parent_id: str | None,
                  attrs: dict[str, Any] | None,
@@ -461,7 +476,12 @@ class Span:
         self._wall0 = time.time()
         self.dur: float | None = None
         self._token: contextvars.Token["Span | None"] | None = None
-        self._thread_name = threading.current_thread().name
+        th = threading.current_thread()
+        self._thread_name = th.name
+        # starting-thread ident, recorded NOW: the profiler joins samples
+        # to the innermost live span per thread, and must not wait for
+        # finish() to learn which thread a span runs on
+        self._thread_ident = th.ident
         #: head-sampled OUT (export tier only): the span still runs —
         #: recorder/statusz/histograms stay whole — but never exports
         self._suppress_export = suppress_export

@@ -43,6 +43,7 @@ constexpr int kRankProxyUpstream = 16;
 constexpr int kRankProxyHint = 18;
 constexpr int kRankProxyRestore = 20;
 constexpr int kRankProxyTelemetry = 22;  // leaf: held only over ring ops
+constexpr int kRankProxyProfile = 24;  // leaf: profiler aggregate only
 constexpr int kRankStoreGc = 30;
 constexpr int kRankStoreWriters = 32;
 constexpr int kRankStoreIndex = 34;

@@ -12,6 +12,7 @@
 #include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
@@ -604,7 +605,13 @@ class Session {
     req_ttfb_set_ = false;
     req_upstream_set_ = false;
   }
-  void route_set(Route r) { req_route_ = r; }
+  void route_set(Route r) {
+    req_route_ = r;
+    // the profiler's shadow stack follows the route resolution: the
+    // worker's generic "serve" top frame becomes the route label, so a
+    // profile slices by the same names as the route histograms
+    p_->profile_retag(kRouteNames[r]);
+  }
   // first upstream response byte of THIS request (forwards and fills
   // only — cache hits never sample): the upstream-leg half of the
   // blended proxy-route latency, observed immediately so the sample
@@ -829,6 +836,58 @@ class Session {
                    "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
                    "Content-Length: %zu\r\nConnection: close\r\n\r\n",
                    body.size());
+        route_ttfb();
+        client_.writev_all(head, ::strlen(head), body.data(), body.size());
+        return false;
+      }
+      if (req.target.rfind("/debug/profile", 0) == 0) {
+        // the continuous profiler: ?seconds= captures a windowed diff of
+        // the always-on folded aggregate (0 = cumulative; clamped ≤ 5 s,
+        // the capture blocks this worker), ?hz= temporarily raises the
+        // rate, ?format=collapsed|json — the native /debug/profile twin
+        route_set(kRouteStatusz);
+        double seconds = 1.0;
+        int hz = 0;
+        bool collapsed = false;
+        size_t qpos = req.target.find('?');
+        if (qpos != std::string::npos) {
+          std::string query = req.target.substr(qpos + 1);
+          size_t at = 0;
+          while (at < query.size()) {
+            size_t amp = query.find('&', at);
+            std::string kv = query.substr(
+                at, amp == std::string::npos ? amp : amp - at);
+            at = amp == std::string::npos ? query.size() : amp + 1;
+            size_t eq = kv.find('=');
+            if (eq == std::string::npos) continue;
+            std::string k = kv.substr(0, eq), v = kv.substr(eq + 1);
+            if (k == "seconds" && !v.empty())
+              seconds = ::atof(v.c_str());
+            else if (k == "hz" && !v.empty())
+              hz = ::atoi(v.c_str());
+            else if (k == "format")
+              collapsed = (v == "collapsed");
+          }
+        }
+        std::string body = p_->profile_json(seconds, hz, collapsed);
+        char head[256];
+        if (body.empty()) {
+          // DEMODEL_OBS=0: the observability tier is off — same 503
+          // contract as the Python plane's /debug/profile
+          body = "{\"error\":\"profiler disabled (DEMODEL_OBS=0)\"}";
+          ::snprintf(head, sizeof head,
+                     "HTTP/1.1 503 Service Unavailable\r\n"
+                     "Content-Type: application/json\r\n"
+                     "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                     body.size());
+        } else {
+          ::snprintf(head, sizeof head,
+                     "HTTP/1.1 200 OK\r\nContent-Type: %s\r\n"
+                     "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                     collapsed ? "text/plain; charset=utf-8"
+                               : "application/json",
+                     body.size());
+        }
         route_ttfb();
         client_.writev_all(head, ::strlen(head), body.data(), body.size());
         return false;
@@ -2571,6 +2630,17 @@ static bool env_reactor_on() {
   return s != "0" && s != "false" && s != "off" && s != "no";
 }
 
+// DEMODEL_OBS: the observability kill switch (the trace.py tier
+// contract) — only an explicit "0"/"false"/"off"/"no" disables; with it
+// off the profiler sampler never starts and /debug/profile answers 503.
+static bool env_obs_on() {
+  // NOLINTNEXTLINE(concurrency-mt-unsafe) — read-only env access (above)
+  const char *v = ::getenv("DEMODEL_OBS");
+  if (!v || !*v) return true;
+  std::string s = lower(v);
+  return s != "0" && s != "false" && s != "off" && s != "no";
+}
+
 std::string Proxy::metrics_json() {
   // gauges read the live pool state at scrape time; counters are already
   // maintained inline
@@ -2658,6 +2728,24 @@ std::string Proxy::statusz_json() {
     out.append(tbuf);
   } else {
     out.append("\"tiers\":null,");  // schema v2: the key is always present
+  }
+  {
+    // profiler vitals — mirrors the Python statusz "profiler" section
+    bool prun = profile_running_.load(std::memory_order_acquire);
+    unsigned long long psamp = 0, pdrop = 0;
+    size_t pstacks = 0;
+    {
+      std::lock_guard<Mutex> g(profile_mu_);
+      psamp = profile_samples_;
+      pdrop = profile_dropped_;
+      pstacks = profile_agg_.size();
+    }
+    char pbuf[192];
+    ::snprintf(pbuf, sizeof pbuf,
+               "\"profiler\":{\"running\":%s,\"hz\":%d,\"samples\":%llu,"
+               "\"stacks\":%zu,\"dropped\":%llu},",
+               prun ? "true" : "false", profile_hz_, psamp, pstacks, pdrop);
+    out.append(pbuf);
   }
   out.append("\"metrics\":");
   out.append(metrics_json());
@@ -2842,7 +2930,271 @@ void Proxy::reject_overflow(int cfd) {
 // the worker owns the connection's whole keep-alive lifetime (bounded by
 // the idle-timeout poll in await_next_request). Exits when stop() flips
 // running_ and the queue is drained.
+// ---- continuous profiler (the native twin of utils/profiler.py) ------
+
+namespace {
+
+//: the calling serve thread's registered shadow-stack slot (null on
+//: unregistered threads — every profiler hook no-ops there)
+thread_local ProfileSlot *t_profile_slot = nullptr;
+
+//: slot-claim sentinel: tid transitions 0 → claim → real tid, so the
+//: sampler (which skips 0 and the sentinel) never reads a half-built slot
+constexpr unsigned long kProfileSlotClaim = ~0ul;
+
+// RAII frame push/pop on the calling thread's shadow stack. Labels MUST
+// be string literals (the sampler dereferences them lock-free).
+class ProfileFrame {
+ public:
+  explicit ProfileFrame(const char *label) : slot_(t_profile_slot) {
+    if (slot_ == nullptr) return;
+    int d = slot_->depth.load(std::memory_order_relaxed);
+    if (d < ProfileSlot::kMaxFrames) {
+      slot_->frames[d].store(label, std::memory_order_release);
+      slot_->depth.store(d + 1, std::memory_order_release);
+      pushed_ = true;
+    }
+  }
+  ~ProfileFrame() {
+    if (!pushed_) return;
+    int d = slot_->depth.load(std::memory_order_relaxed);
+    if (d > 0) slot_->depth.store(d - 1, std::memory_order_release);
+  }
+  ProfileFrame(const ProfileFrame &) = delete;
+  ProfileFrame &operator=(const ProfileFrame &) = delete;
+
+ private:
+  ProfileSlot *slot_;
+  bool pushed_ = false;
+};
+
+// RAII slot registration for a serve-loop thread (worker/reactor/accept).
+class ProfileThread {
+ public:
+  ProfileThread(Proxy *p, const char *label)
+      : p_(p), slot_(p->profile_register(label)) {}
+  ~ProfileThread() { p_->profile_release(slot_); }
+  ProfileThread(const ProfileThread &) = delete;
+  ProfileThread &operator=(const ProfileThread &) = delete;
+
+ private:
+  Proxy *p_;
+  ProfileSlot *slot_;
+};
+
+}  // namespace
+
+ProfileSlot *Proxy::profile_register(const char *label) {
+  unsigned long tid = static_cast<unsigned long>(::syscall(SYS_gettid));
+  for (int i = 0; i < kProfileSlots; ++i) {
+    ProfileSlot &s = profile_slots_[i];
+    unsigned long expect = 0;
+    if (!s.tid.compare_exchange_strong(expect, kProfileSlotClaim,
+                                       std::memory_order_acq_rel))
+      continue;
+    s.pt = ::pthread_self();
+    s.last_cpu = -1.0;
+    s.last_wall = 0.0;
+    for (int j = 0; j < ProfileSlot::kMaxFrames; ++j)
+      s.frames[j].store(nullptr, std::memory_order_relaxed);
+    s.frames[0].store(label, std::memory_order_relaxed);
+    s.depth.store(1, std::memory_order_relaxed);
+    s.tid.store(tid, std::memory_order_release);
+    t_profile_slot = &s;
+    return &s;
+  }
+  return nullptr;  // more serve threads than slots: the rest go unprofiled
+}
+
+void Proxy::profile_release(ProfileSlot *slot) {
+  if (slot == nullptr) return;
+  if (t_profile_slot == slot) t_profile_slot = nullptr;
+  slot->depth.store(0, std::memory_order_relaxed);
+  slot->tid.store(0, std::memory_order_release);
+}
+
+void Proxy::profile_retag(const char *label) {
+  ProfileSlot *s = t_profile_slot;
+  if (s == nullptr) return;
+  int d = s->depth.load(std::memory_order_relaxed);
+  if (d > 0 && d <= ProfileSlot::kMaxFrames)
+    s->frames[d - 1].store(label, std::memory_order_release);
+}
+
+// caller holds profile_mu_. Bounded: past DEMODEL_PROFILE_MAX_STACKS
+// distinct keys, new stacks fold into "(other)" + the drop counter —
+// same overflow contract as the Python plane.
+void Proxy::profile_bump(const std::string &key, bool on_cpu) {
+  auto it = profile_agg_.find(key);
+  if (it == profile_agg_.end()) {
+    if (static_cast<int>(profile_agg_.size()) >= profile_cap_) {
+      profile_dropped_++;
+      it = profile_agg_.emplace("(other)", std::make_pair(0ull, 0ull))
+               .first;
+    } else {
+      it = profile_agg_.emplace(key, std::make_pair(0ull, 0ull)).first;
+    }
+  }
+  it->second.first++;
+  if (on_cpu) it->second.second++;
+}
+
+void Proxy::profile_loop() {
+  using std::chrono::duration;
+  while (profile_running_.load(std::memory_order_acquire)) {
+    int hz = profile_hz_override_.load(std::memory_order_relaxed);
+    if (hz <= 0) hz = profile_hz_;
+    if (hz <= 0) hz = 19;
+    double now = duration<double>(
+                     std::chrono::steady_clock::now().time_since_epoch())
+                     .count();
+    for (int i = 0; i < kProfileSlots; ++i) {
+      ProfileSlot &s = profile_slots_[i];
+      unsigned long tid = s.tid.load(std::memory_order_acquire);
+      if (tid == 0 || tid == kProfileSlotClaim) continue;
+      int d = s.depth.load(std::memory_order_acquire);
+      if (d <= 0) continue;
+      if (d > ProfileSlot::kMaxFrames) d = ProfileSlot::kMaxFrames;
+      std::string key;
+      for (int j = 0; j < d; ++j) {
+        const char *f = s.frames[j].load(std::memory_order_acquire);
+        if (f == nullptr) break;
+        if (!key.empty()) key += ';';
+        key += f;
+      }
+      if (key.empty()) continue;
+      // wall vs on-CPU via the owner's per-thread CPU clock. The slot's
+      // pthread_t stays valid the whole time this loop runs: stop()
+      // joins the sampler BEFORE any registered serve thread can exit.
+      bool on_cpu = false;
+      clockid_t ck;
+      if (::pthread_getcpuclockid(s.pt, &ck) == 0) {
+        struct timespec tsp;
+        if (::clock_gettime(ck, &tsp) == 0) {
+          double cpu = static_cast<double>(tsp.tv_sec) +
+                       static_cast<double>(tsp.tv_nsec) / 1e9;
+          if (s.last_cpu >= 0.0 && now > s.last_wall)
+            on_cpu = (cpu - s.last_cpu) >= 0.5 * (now - s.last_wall);
+          s.last_cpu = cpu;
+          s.last_wall = now;
+        }
+      }
+      std::lock_guard<Mutex> g(profile_mu_);
+      profile_bump(key, on_cpu);
+      profile_samples_++;
+    }
+    // wait_until on the SYSTEM clock, same rationale as fill_wait: a
+    // steady-clock wait_for lowers to pthread_cond_clockwait, which older
+    // libtsan builds do not intercept (bogus double-lock reports)
+    std::unique_lock<std::mutex> lk(profile_wake_mu_);
+    profile_wake_cv_.wait_until(
+        lk,
+        std::chrono::system_clock::now() +
+            std::chrono::microseconds(1000000 / hz),
+        [this] { return !profile_running_.load(std::memory_order_acquire); });
+  }
+}
+
+std::string Proxy::profile_json(double seconds, int hz, bool collapsed) {
+  if (!profile_running_.load(std::memory_order_acquire))
+    return "";  // DEMODEL_OBS=0 — callers answer 503
+  if (seconds < 0.0) seconds = 0.0;
+  if (seconds > 5.0) seconds = 5.0;  // the capture blocks one worker
+  if (hz < 0) hz = 0;
+  if (hz > 1000) hz = 1000;
+  std::unordered_map<std::string, std::pair<uint64_t, uint64_t>> before;
+  if (seconds > 0.0) {
+    {
+      std::lock_guard<Mutex> g(profile_mu_);
+      before = profile_agg_;
+    }
+    if (hz > 0) profile_hz_override_.store(hz, std::memory_order_relaxed);
+    // chunked sleep: stop() must not wait a whole capture out
+    double left = seconds;
+    while (left > 0.0 &&
+           profile_running_.load(std::memory_order_acquire)) {
+      double step = left < 0.05 ? left : 0.05;
+      ::usleep(static_cast<useconds_t>(step * 1e6));
+      left -= step;
+    }
+    if (hz > 0) profile_hz_override_.store(0, std::memory_order_relaxed);
+  }
+  std::unordered_map<std::string, std::pair<uint64_t, uint64_t>> agg;
+  uint64_t dropped = 0;
+  {
+    std::lock_guard<Mutex> g(profile_mu_);
+    agg = profile_agg_;
+    dropped = profile_dropped_;
+  }
+  // capture = cumulative₂ − cumulative₁: concurrent captures (and the
+  // sampler's own bookkeeping) never consume each other's baseline
+  std::vector<std::pair<std::string, std::pair<uint64_t, uint64_t>>> rows;
+  rows.reserve(agg.size());
+  uint64_t total = 0;
+  for (auto &kv : agg) {
+    uint64_t wall = kv.second.first, cpu = kv.second.second;
+    auto it = before.find(kv.first);
+    if (it != before.end()) {
+      wall -= it->second.first;
+      cpu -= it->second.second;
+    }
+    if (wall == 0 && cpu == 0) continue;
+    total += wall;
+    rows.emplace_back(kv.first, std::make_pair(wall, cpu));
+  }
+  std::sort(rows.begin(), rows.end(), [](const auto &a, const auto &b) {
+    return a.second.first != b.second.first ? a.second.first > b.second.first
+                                            : a.first < b.first;
+  });
+  // bounded document: top 256 stacks verbatim, the tail as one "(other)"
+  constexpr size_t kTop = 256;
+  if (rows.size() > kTop) {
+    uint64_t ow = 0, oc = 0;
+    for (size_t i = kTop; i < rows.size(); ++i) {
+      ow += rows[i].second.first;
+      oc += rows[i].second.second;
+    }
+    rows.resize(kTop);
+    rows.emplace_back("(other)", std::make_pair(ow, oc));
+  }
+  if (collapsed) {
+    std::string out;
+    for (auto &r : rows) {
+      if (r.second.first == 0) continue;
+      out += r.first;
+      out += ' ';
+      out += std::to_string(r.second.first);
+      out += '\n';
+    }
+    if (out.empty()) out = "\n";  // non-empty: "" means profiler OFF
+    return out;
+  }
+  char buf[512];
+  ::snprintf(buf, sizeof buf,
+             "{\"plane\":\"native\",\"hz\":%d,\"seconds\":%.3f,"
+             "\"samples\":%llu,\"dropped\":%llu,\"stacks\":[",
+             hz > 0 ? hz : profile_hz_, seconds,
+             (unsigned long long)total, (unsigned long long)dropped);
+  std::string out = buf;
+  bool first = true;
+  for (auto &r : rows) {
+    // keys are joined string literals under our control — no escaping
+    ::snprintf(buf, sizeof buf,
+               "%s{\"stack\":\"%s\",\"wall\":%llu,\"cpu\":%llu}",
+               first ? "" : ",", r.first.c_str(),
+               (unsigned long long)r.second.first,
+               (unsigned long long)r.second.second);
+    out.append(buf);
+    first = false;
+  }
+  out.append("]}");
+  return out;
+}
+
 void Proxy::worker_loop() {
+  // shadow-stack registration: this worker's samples fold under
+  // "worker;…" with the top frame retagged to the route being served
+  ProfileThread preg(this, "worker");
   for (;;) {
     Session *s = nullptr;
     {
@@ -2863,7 +3215,11 @@ void Proxy::worker_loop() {
       }
     }
     if (reactor_enabled_) {
-      Session::Disp d = s->step();
+      Session::Disp d;
+      {
+        ProfileFrame pf("serve");
+        d = s->step();
+      }
       live_sessions_--;
       if (d == Session::Disp::kPark)
         reactor_park(s);
@@ -2872,6 +3228,7 @@ void Proxy::worker_loop() {
     } else {
       for (;;) {
         if (!s->await_next_request()) break;
+        ProfileFrame pf("serve");
         if (s->step() == Session::Disp::kClose) break;
       }
       delete s;
@@ -2931,6 +3288,12 @@ int Proxy::start() {
                    ? cfg_.max_conns
                    : env_pos_int("DEMODEL_PROXY_MAX_CONNS", 65536);
   if (max_conns_ <= 0) max_conns_ = 4096;
+  // continuous profiler knobs (shared with the Python plane — the
+  // surface-parity analyzer keeps the names and defaults in lockstep)
+  profile_hz_ = env_pos_int("DEMODEL_PROFILE_HZ", 1000);
+  if (profile_hz_ == 0) profile_hz_ = 19;
+  profile_cap_ = env_pos_int("DEMODEL_PROFILE_MAX_STACKS", 65536);
+  if (profile_cap_ == 0) profile_cap_ = 2048;
 
   if (reactor_enabled_) {
     epoll_fd_ = ::epoll_create1(EPOLL_CLOEXEC);
@@ -2962,6 +3325,7 @@ int Proxy::start() {
     reactor_thread_ = std::thread([this] { reactor_loop(); });
 
   accept_thread_ = std::thread([this] {
+    ProfileThread preg(this, "accept");
     while (running_) {
       int cfd = ::accept(listen_fd_, nullptr, nullptr);
       if (cfd < 0) {
@@ -2999,11 +3363,25 @@ int Proxy::start() {
         reject_overflow(cfd);
     }
   });
+  // the sampler starts LAST and stop() joins it FIRST: while it runs,
+  // every registered slot's pthread_t belongs to a live serve thread
+  if (env_obs_on()) {
+    profile_running_.store(true, std::memory_order_release);
+    profile_thread_ = std::thread([this] { profile_loop(); });
+  }
   return 0;
 }
 
 void Proxy::stop() {
   if (!running_.exchange(false)) return;
+  // sampler first (see start()): once it is joined, serve threads may
+  // exit without invalidating a pthread_t the sampler could still read
+  profile_running_.store(false, std::memory_order_release);
+  {
+    std::lock_guard<std::mutex> g(profile_wake_mu_);
+  }
+  profile_wake_cv_.notify_all();
+  if (profile_thread_.joinable()) profile_thread_.join();
   // shutdown (not close/assign) first: the accept thread still reads
   // listen_fd_; mutate it only after the join
   int fd = listen_fd_;
@@ -3089,6 +3467,7 @@ void Proxy::reactor_park(Session *s) {
 }
 
 void Proxy::reactor_loop() {
+  ProfileThread preg(this, "reactor");
   // park deadline: the keep-alive idle bound, capped by io_timeout (a
   // parked conn has no read in flight, so SO_RCVTIMEO cannot govern it
   // the way it did when a worker owned the idle wait)
@@ -3793,6 +4172,23 @@ int dm_proxy_metrics(void *p, char *buf, int buflen) {
     int n = static_cast<int>(j.size());
     if (n >= buflen) n = buflen - 1;
     ::memcpy(buf, j.data(), static_cast<size_t>(n));
+    buf[n] = 0;
+  }
+  return static_cast<int>(j.size());
+}
+
+// Capture a profile window (seconds_ms of live sampling; 0 = cumulative)
+// and copy it out, truncating to buflen like dm_proxy_metrics. Returns
+// the FULL document length so a truncated caller can retry with a bigger
+// buffer; 0 means the profiler is off (DEMODEL_OBS=0).
+int dm_proxy_profile(void *p, int seconds_ms, int hz, int collapsed,
+                     char *buf, int buflen) {
+  std::string j = static_cast<dm::Proxy *>(p)->profile_json(
+      seconds_ms / 1000.0, hz, collapsed != 0);
+  if (buf && buflen > 0) {
+    int n = static_cast<int>(j.size());
+    if (n >= buflen) n = buflen - 1;
+    if (n > 0) ::memcpy(buf, j.data(), static_cast<size_t>(n));
     buf[n] = 0;
   }
   return static_cast<int>(j.size());

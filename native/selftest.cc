@@ -934,6 +934,77 @@ static void test_telemetry_endpoint(const std::string &root) {
   delete p;
 }
 
+static void test_profile_endpoint(const std::string &root) {
+  // GET /debug/profile answers the continuous profiler's view: a live
+  // capture window (seconds=) diffed out of the cumulative aggregate,
+  // as JSON or collapsed flame-graph lines. Traffic during the window
+  // must attribute samples to the serve threads' shadow stacks — the
+  // sampler reads those stacks lock-free while workers mutate them, so
+  // this scenario is the ASan/TSan proof of the publication protocol.
+  ::setenv("DEMODEL_PROFILE_HZ", "200", 1);  // dense sampling, short test
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/profilestore";
+  cfg.verbose = false;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "profile proxy start");
+  int port = p->port();
+
+  // churn requests from several clients while a capture window runs —
+  // the retag hook and frame push/pop race the sampler on purpose
+  std::atomic<bool> go{true};
+  std::vector<std::thread> churn;
+  for (int i = 0; i < 4; i++)
+    churn.emplace_back([&] {
+      while (go.load()) pool_get(port, "/healthz");
+    });
+  std::string resp = pool_get(port, "/debug/profile?seconds=0.3&hz=200");
+  go.store(false);
+  for (auto &t : churn) t.join();
+  CHECK(resp.find("200 OK") != std::string::npos, "profile 200");
+  CHECK(resp.find("\"plane\":\"native\"") != std::string::npos,
+        "profile plane tag");
+  CHECK(resp.find("\"stacks\":[") != std::string::npos, "profile stacks");
+  // with 4 clients hammering healthz through a 0.3 s window at 200 Hz,
+  // worker samples are statistically guaranteed — and their top frame
+  // was retagged to the route name by route_set
+  CHECK(resp.find("worker") != std::string::npos, "worker thread sampled");
+
+  std::string coll =
+      pool_get(port, "/debug/profile?seconds=0&format=collapsed");
+  CHECK(coll.find("200 OK") != std::string::npos, "collapsed 200");
+  CHECK(coll.find("text/plain") != std::string::npos, "collapsed ctype");
+  CHECK(coll.find("worker;") != std::string::npos, "collapsed stack line");
+
+  // statusz carries the profiler vitals section
+  std::string sz = pool_get(port, "/debug/statusz");
+  CHECK(sz.find("\"profiler\":{\"running\":true") != std::string::npos,
+        "statusz profiler section");
+  p->stop();
+  delete p;
+
+  // DEMODEL_OBS=0 answers 503 and leaves the proxy serving normally
+  ::setenv("DEMODEL_OBS", "0", 1);
+  dm::ProxyConfig cfg2;
+  cfg2.host = "127.0.0.1";
+  cfg2.port = 0;
+  cfg2.store_root = root + "/profilestore2";
+  cfg2.verbose = false;
+  auto *p2 = new dm::Proxy(std::move(cfg2));
+  CHECK(p2->start() == 0, "obs-off proxy start");
+  std::string off = pool_get(p2->port(), "/debug/profile");
+  CHECK(off.find("503") != std::string::npos, "obs-off profile 503");
+  CHECK(off.find("profiler disabled") != std::string::npos,
+        "obs-off profile body");
+  std::string hz = pool_get(p2->port(), "/healthz");
+  CHECK(hz.find("200 OK") != std::string::npos, "obs-off still serves");
+  p2->stop();
+  delete p2;
+  ::unsetenv("DEMODEL_OBS");
+  ::unsetenv("DEMODEL_PROFILE_HZ");
+}
+
 static void test_peer_window_fetch(const std::string &root) {
   // a proxy whose store holds one ~8 MB object; windows of it are fetched
   // back through /peer/object with the multi-stream ranged fan-out — the
@@ -1228,6 +1299,7 @@ int main() {
   test_reactor_stop_parked(root);
   test_statusz_endpoint(root);
   test_telemetry_endpoint(root);
+  test_profile_endpoint(root);
   test_peer_window_fetch(root);
   test_hot_tier(root);
   test_single_flight(root);

@@ -47,3 +47,10 @@ def history_reads(archive, route):
     archive.history()                                    # filterless: ok
     archive.history(family="pulls_totl")                 # typo: no write
     archive.history(family=f"serve_{route}_seconds")     # non-literal
+
+
+def profile_reads(archive, which):
+    archive.profiles(plane="python")                     # known plane: ok
+    archive.profiles()                                   # filterless: ok
+    archive.profiles(plane="pythn")                      # typo'd plane
+    archive.profiles(plane=which)                        # non-literal

@@ -13,7 +13,8 @@ def resolve():
     depth = env_int("DEMODEL_FAKE_DEPTH", 4)
     once = env_int("DEMODEL_FAKE_TWICE", 5)
     again = env_int("DEMODEL_FAKE_TWICE", 7)
-    return gap, flag, depth, once, again
+    hz = env_int("DEMODEL_PROFILE_HZ", 19)
+    return gap, flag, depth, once, again, hz
 
 
 PROXY_GAUGES = frozenset({"depth", "reqs", "phantom"})

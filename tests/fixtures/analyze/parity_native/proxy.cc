@@ -9,6 +9,8 @@ void resolve_knobs() {
   if (min_ms == 0) min_ms = 999;
   int depth = env_pos_int("DEMODEL_FAKE_DEPTH");
   if (depth <= 0) depth = 4;
+  int phz = env_pos_int("DEMODEL_PROFILE_HZ", 1000);
+  if (phz == 0) phz = 97;
 }
 
 static bool env_flag_on() {

@@ -126,6 +126,9 @@ GOLDEN = {
         # the retention side: archive.history(family=...) lookups
         ("metric-hygiene", 48),
         ("metric-hygiene", 49),
+        # the profiler side: archive.profiles(plane=...) lookups
+        ("metric-hygiene", 55),
+        ("metric-hygiene", 56),
     },
     # PR 5 receiver-typing upgrades: blocking I/O reached only through a
     # constructor-typed self-attribute / an executor-submit edge
@@ -167,8 +170,9 @@ GOLDEN = {
         ("surface-parity", 11),   # knob default drift native↔Python
         ("surface-parity", 12),   # knob type drift (int vs bool)
         ("surface-parity", 15),   # one knob, two Python defaults
-        ("surface-parity", 19),   # PROXY_GAUGES: phantom/counter/missing
-        ("surface-parity", 21),   # rank mirror: drift/stale/missing
+        ("surface-parity", 16),   # DEMODEL_PROFILE_HZ fallback drift
+        ("surface-parity", 20),   # PROXY_GAUGES: phantom/counter/missing
+        ("surface-parity", 22),   # rank mirror: drift/stale/missing
         ("surface-parity", 7),    # parity_native/lock_order.h: dup rank
         ("surface-parity", 8),    # parity_native/proxy.cc: unwindowed hist
     },

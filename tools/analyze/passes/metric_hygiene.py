@@ -26,7 +26,10 @@ analyzed tree actually registers (checked in :meth:`finalize`, once the
 whole run's write set is known). Retention-plane history queries
 (``archive.history(family=...)``) have the same failure mode — a typo'd
 family filter returns an empty (not wrong) series from a full archive —
-and get the same check; a filterless ``history()`` is fine.
+and get the same check; a filterless ``history()`` is fine. The profiler
+plane's ``archive.profiles(plane=...)`` filter is checked against the
+two planes that exist (``python`` / ``native``): a typo'd plane silently
+reads as "no profiles archived".
 
 Scope: files under ``demodel_tpu/`` plus any file carrying an explicit
 ``# demodel: metrics-plane`` pragma (how the golden fixture opts in).
@@ -69,6 +72,8 @@ _PLANES = {"demodel_tpu/utils/metrics.py",
            "demodel_tpu/utils/retention.py"}
 _PRAGMA = "# demodel: metrics-plane"
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+#: the only planes the profiler plane records windows under
+_PROFILE_PLANES = {"python", "native"}
 
 
 def _is_labeled_call(call: ast.Call) -> bool:
@@ -246,6 +251,33 @@ class MetricHygienePass(Pass):
                 else:
                     for name in resolver.names:
                         self._reads.append((ctx.rel, node.lineno, name))
+            elif attr == "profiles" and in_scope \
+                    and ctx.rel not in _PLANES \
+                    and _is_history_receiver(node.func.value):
+                # plane filter: positional (since, until, plane) or
+                # plane=; filterless (or plane=None) reads every plane
+                plane_expr = node.args[2] if len(node.args) > 2 else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "plane"), None)
+                if plane_expr is None or (
+                        isinstance(plane_expr, ast.Constant)
+                        and plane_expr.value is None):
+                    continue
+                if isinstance(plane_expr, ast.Constant) \
+                        and isinstance(plane_expr.value, str):
+                    if plane_expr.value not in _PROFILE_PLANES:
+                        yield Finding(
+                            ctx.rel, node.lineno, self.id,
+                            f"profile read of plane {plane_expr.value!r} "
+                            "— only "
+                            f"{sorted(_PROFILE_PLANES)} exist; the filter "
+                            "silently returns zero windows")
+                else:
+                    yield Finding(
+                        ctx.rel, node.lineno, self.id,
+                        "profile read: plane filter is not a literal — "
+                        "a computed plane that matches nothing reads as "
+                        "'no profiles archived'")
 
     def finalize(self) -> Iterator[Finding]:
         if not self._written:

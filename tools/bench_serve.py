@@ -65,6 +65,8 @@ def _env_f(name: str, default: float) -> float:
 
 SMOKE = ("--smoke" in sys.argv
          or os.environ.get("DEMODEL_SERVE_SMOKE", "").strip() == "1")
+PROFILE = ("--profile" in sys.argv
+           or os.environ.get("DEMODEL_SERVE_PROFILE", "").strip() == "1")
 OBJ_MB = int(_env_f("DEMODEL_SERVE_OBJ_MB", 1 if SMOKE else 8))
 N_OBJECTS = int(_env_f("DEMODEL_SERVE_OBJECTS", 2 if SMOKE else 4))
 N_CLIENTS = int(_env_f("DEMODEL_SERVE_CLIENTS", 4 if SMOKE else 8))
@@ -321,6 +323,61 @@ def _hist_crosscheck(native: dict, out: dict) -> dict:
     return checks
 
 
+def _profile_leg(tmp: Path) -> dict:
+    """The ``--profile`` leg: hot object hits with the native sampler on
+    (capturing a collapsed flame during the leg) vs a ``DEMODEL_OBS=0``
+    node — the overhead guard for the native-plane sampler at default Hz."""
+    keys = _warm_store(tmp / "profile-node" / "cache", 1, OBJ_MB)
+    path_for = lambda w, i: f"/peer/object/{keys[0]}"  # noqa: E731
+
+    def leg(node) -> float:
+        _reqs, nbytes, _l = _hammer(node.port, path_for, LEG_SECS,
+                                    N_CLIENTS, expect_body=True)
+        return nbytes / 1e6 / LEG_SECS
+
+    out: dict = {"collapsed": None}
+    collapsed: list[str | None] = [None]
+    # the gate retries once: a 19 Hz sampler over <300 slots costs well
+    # under 1%, so a miss is loopback/CI scheduling noise
+    for _attempt in range(2):
+        node = _node(tmp / "profile-node").start()
+        try:
+            grab = threading.Thread(
+                target=lambda: collapsed.__setitem__(
+                    0, node.profile(seconds=min(LEG_SECS, 2.0),
+                                    fmt="collapsed")))
+            grab.start()
+            on_mbs = leg(node)
+            grab.join()
+        finally:
+            node.stop()
+        os.environ["DEMODEL_OBS"] = "0"
+        try:
+            node = _node(tmp / "profile-node").start()
+            try:
+                off_mbs = leg(node)
+            finally:
+                node.stop()
+        finally:
+            del os.environ["DEMODEL_OBS"]
+        out.update({
+            "off_mb_s": round(off_mbs, 2),
+            "on_mb_s": round(on_mbs, 2),
+            "overhead_ratio": round(on_mbs / off_mbs, 4) if off_mbs
+            else None,
+        })
+        out["profile_ok"] = bool(off_mbs and on_mbs >= 0.95 * off_mbs)
+        if out["profile_ok"]:
+            break
+    if collapsed[0]:
+        dest = Path(os.environ.get("DEMODEL_PROFILE_OUT",
+                                   "bench_serve.profile.collapsed"))
+        dest.write_text(collapsed[0])
+        out["collapsed"] = str(dest)
+    print(f"[bench_serve] profile: {out}", file=sys.stderr)
+    return out
+
+
 def _raise_nofile(need: int) -> None:
     import resource
 
@@ -543,6 +600,7 @@ def main() -> int:
 
         flood = _flood(tmp)
         c10k = _flood_c10k(tmp)
+        profile = _profile_leg(tmp) if PROFILE else None
         if c10k.get("hot_mb_s_with_parked") and out.get("object_mb_s"):
             # active-request throughput with ~C10K conns parked vs the
             # plain leg — the "parked conns are free" claim, quantified
@@ -562,6 +620,7 @@ def main() -> int:
         **out,
         "flood": flood,
         "c10k": c10k,
+        **({"profile": profile} if profile is not None else {}),
         **({"native_serve_bytes_total": native["serve_bytes_total"]}
            if "serve_bytes_total" in native else {}),
     }
@@ -574,6 +633,10 @@ def main() -> int:
         return 1
     if out.get("hist_p99_agree") is False:
         print("[bench_serve] HISTOGRAM/CLIENT P99 DISAGREE", file=sys.stderr)
+        return 1
+    if profile is not None and profile.get("profile_ok") is False:
+        print("[bench_serve] PROFILER OVERHEAD GATE VIOLATED",
+              file=sys.stderr)
         return 1
     return 0
 

@@ -50,6 +50,8 @@ def _env_f(name: str, default: float) -> float:
 
 SMOKE = ("--smoke" in sys.argv
          or os.environ.get("DEMODEL_STORE_SMOKE", "").strip() == "1")
+PROFILE = ("--profile" in sys.argv
+           or os.environ.get("DEMODEL_STORE_PROFILE", "").strip() == "1")
 OBJ_MB = int(_env_f("DEMODEL_STORE_OBJ_MB", 4 if SMOKE else 16))
 N_CLIENTS = int(_env_f("DEMODEL_STORE_CLIENTS", 32 if SMOKE else 128))
 LEG_SECS = _env_f("DEMODEL_STORE_SECS", 0.5 if SMOKE else 2.0)
@@ -220,11 +222,74 @@ def _reread(tmp: Path) -> dict:
     return out
 
 
+def _profile_leg(tmp: Path) -> dict:
+    """The ``--profile`` leg: hot re-reads with the continuous profiler
+    off, then on (capturing a collapsed flame next to the BENCH json) —
+    the overhead guard for the Python-plane sampler at default Hz."""
+    from demodel_tpu import tier
+    from demodel_tpu.store import Store
+    from demodel_tpu.utils import profiler
+
+    body = os.urandom(1 << 20) * OBJ_MB
+    store = Store(tmp / "profleg")
+    store.put("proflegobj0000001", body,
+              {"content-type": "application/octet-stream"})
+
+    def leg() -> float:
+        assert ts.read("proflegobj0000001") == body
+        stop = time.perf_counter() + LEG_SECS
+        reads = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() < stop:
+            if len(ts.read("proflegobj0000001")) != len(body):
+                raise AssertionError("short re-read")
+            reads += 1
+        return reads * len(body) / 1e6 / (time.perf_counter() - t0)
+
+    ts = tier.TieredStore(store, name="bench-profile")
+    out: dict = {"hz": None, "collapsed": None}
+    try:
+        # the gate retries once: a 19 Hz sampler costs well under 1%, so
+        # a miss is loopback/CI scheduling noise, not profiler overhead
+        for _attempt in range(2):
+            profiler.stop()
+            off_mbs = leg()
+            prof = profiler.ensure()
+            if prof is None:  # DEMODEL_OBS=0: nothing to measure
+                out.update({"profile_ok": None, "off_mb_s": round(off_mbs, 2)})
+                return out
+            out["hz"] = prof.hz
+            on_mbs = leg()
+            cap = profiler.capture(seconds=0)  # cumulative = this leg
+            profiler.stop()
+            out.update({
+                "off_mb_s": round(off_mbs, 2),
+                "on_mb_s": round(on_mbs, 2),
+                "overhead_ratio": round(on_mbs / off_mbs, 4) if off_mbs
+                else None,
+                "samples": cap["samples"] if cap else 0,
+            })
+            out["profile_ok"] = bool(off_mbs and on_mbs >= 0.95 * off_mbs)
+            if out["profile_ok"]:
+                break
+        if cap:
+            dest = Path(os.environ.get("DEMODEL_PROFILE_OUT",
+                                       "bench_store.profile.collapsed"))
+            dest.write_text(profiler.collapse(cap))
+            out["collapsed"] = str(dest)
+    finally:
+        ts.close()
+        store.close()
+    print(f"[bench_store] profile: {out}", file=sys.stderr)
+    return out
+
+
 def main() -> int:
     with tempfile.TemporaryDirectory() as td:
         tmp = Path(td)
         herd = _herd(tmp)
         reread = _reread(tmp)
+        profile = _profile_leg(tmp) if PROFILE else None
 
     result = {
         "metric": "store_herd_origin_fetches",
@@ -235,12 +300,18 @@ def main() -> int:
         "herd": herd,
         "reread": reread,
     }
+    if profile is not None:
+        result["profile"] = profile
     print(json.dumps(result))
     if not herd["herd_ok"]:
         print("[bench_store] HERD CONTRACT VIOLATED", file=sys.stderr)
         return 1
     if not reread["reread_ok"]:
         print("[bench_store] REREAD CONTRACT VIOLATED", file=sys.stderr)
+        return 1
+    if profile is not None and profile.get("profile_ok") is False:
+        print("[bench_store] PROFILER OVERHEAD GATE VIOLATED",
+              file=sys.stderr)
         return 1
     return 0
 

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Flame report over profiler captures — ONE JSON line.
+
+Reads captures from the continuous profiling plane
+(:mod:`demodel_tpu.utils.profiler` and the native ``/debug/profile``
+twin) in any of three shapes:
+
+- **JSON captures** (``/debug/profile`` default output, or an archived
+  window record): ``{"stacks": [{"stack": "a;b;c", "wall": N, "cpu": N}]}``;
+- **collapsed text** (``format=collapsed``): ``a;b;c COUNT`` lines,
+  ready for external flame-graph tooling;
+- **archive directories** (``DEMODEL_TELEMETRY_ARCHIVE``): every
+  ``kind=profile`` window record the retention plane flushed, merged —
+  spanning node restarts, because the archive does.
+
+The report gives top-N frames by *self* (leaf) and *total* (anywhere on
+the stack) time plus the per-span breakdown the trace join enables: the
+root segment of a Python-plane stack is the innermost active span
+(``window-read``, ``place``, …), of a native stack the serve thread.
+
+``--diff BASELINE`` renders the flame diff against an earlier capture
+and exits **rc=1** when any frame's share of samples grew by at least
+``--threshold`` (default 0.05 — five share points): the gate that makes
+a bench regression attributable to a frame, not just a number.
+``--validate`` is the parse-only CI smoke gate, same contract as
+``telemetry_report.py``.
+
+Usage::
+
+    python tools/profile_report.py prof.json
+    python tools/profile_report.py after.collapsed --diff before.collapsed
+    python tools/profile_report.py /var/tmp/telemetry-archive --plane python
+    python tools/profile_report.py prof.json --validate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _merge(agg: dict[str, list[float]], stack: str, wall: float,
+           cpu: float) -> None:
+    row = agg.setdefault(stack, [0.0, 0.0])
+    row[0] += wall
+    row[1] += cpu
+
+
+def _load_json_doc(doc: dict, agg: dict[str, list[float]]) -> int:
+    n = 0
+    for row in doc.get("stacks") or []:
+        stack = row.get("stack")
+        if not stack:
+            continue
+        _merge(agg, str(stack), float(row.get("wall") or 0.0),
+               float(row.get("cpu") or 0.0))
+        n += 1
+    return n
+
+
+def load(path: Path, plane: str | None = None) -> dict[str, list[float]]:
+    """``{stack: [wall, cpu]}`` of one capture file or archive dir.
+
+    A missing path is fatal — the smoke gate's whole point is "the
+    capture exists and parses".
+    """
+    agg: dict[str, list[float]] = {}
+    path = Path(path)
+    if path.is_dir():
+        from demodel_tpu.utils.retention import TelemetryArchive
+        for rec in TelemetryArchive(path).profiles(plane=plane):
+            _load_json_doc(rec, agg)
+        return agg
+    if not path.is_file():
+        raise SystemExit(f"{path}: no such capture file or archive")
+    text = path.read_text()
+    if text.lstrip().startswith("{"):
+        _load_json_doc(json.loads(text), agg)
+        return agg
+    # collapsed: "seg;seg;seg COUNT" per line (wall counts only)
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, count = line.rpartition(" ")
+        if not stack:
+            continue
+        try:
+            _merge(agg, stack, float(count), 0.0)
+        except ValueError:
+            continue
+    return agg
+
+
+def _frames(agg: dict[str, list[float]]) -> dict[str, dict[str, float]]:
+    """Per-frame self/total/cpu rollup over the folded stacks."""
+    out: dict[str, dict[str, float]] = {}
+    for stack, (wall, cpu) in agg.items():
+        segs = stack.split(";")
+        for seg in set(segs):  # count a frame once per stack, not per repeat
+            row = out.setdefault(seg, {"self": 0.0, "total": 0.0, "cpu": 0.0})
+            row["total"] += wall
+            row["cpu"] += cpu
+        out[segs[-1]]["self"] += wall
+    return out
+
+
+def _spans(agg: dict[str, list[float]]) -> dict[str, dict[str, float]]:
+    """Root-segment breakdown: the span join for Python-plane stacks
+    (span names carry no ``:``), the serve thread for native ones."""
+    out: dict[str, dict[str, float]] = {}
+    for stack, (wall, cpu) in agg.items():
+        root = stack.split(";", 1)[0]
+        if ":" in root or root == "-":
+            root = "(unattributed)"  # "-" is the profiler's no-span root
+        row = out.setdefault(root, {"wall": 0.0, "cpu": 0.0})
+        row["wall"] += wall
+        row["cpu"] += cpu
+    return out
+
+
+def report(agg: dict[str, list[float]], top: int = 10) -> dict:
+    total = sum(w for w, _ in agg.values())
+    frames = _frames(agg)
+
+    def rank(key: str) -> list[dict]:
+        rows = sorted(frames.items(), key=lambda kv: (-kv[1][key], kv[0]))
+        return [{"frame": f, "self": round(r["self"], 3),
+                 "total": round(r["total"], 3),
+                 "share": round(r[key] / total, 4) if total else 0.0}
+                for f, r in rows[:top] if r[key] > 0]
+
+    spans = {
+        name: {"wall": round(r["wall"], 3), "cpu": round(r["cpu"], 3),
+               "share": round(r["wall"] / total, 4) if total else 0.0}
+        for name, r in sorted(_spans(agg).items(),
+                              key=lambda kv: -kv[1]["wall"])
+    }
+    return {
+        "metric": "profile_report",
+        "samples": round(total, 3),
+        "stacks": len(agg),
+        "top_self": rank("self"),
+        "top_total": rank("total"),
+        "spans": spans,
+    }
+
+
+def diff(after: dict[str, list[float]], before: dict[str, list[float]],
+         threshold: float, top: int = 10) -> tuple[dict, int]:
+    """Flame diff by per-frame sample share; rc=1 on regression.
+
+    Shares (frame total / capture total) rather than raw counts, so two
+    captures of different lengths or rates compare honestly.
+    """
+    a_total = sum(w for w, _ in after.values()) or 1.0
+    b_total = sum(w for w, _ in before.values()) or 1.0
+    a_frames = _frames(after)
+    b_frames = _frames(before)
+    deltas = []
+    for frame in set(a_frames) | set(b_frames):
+        a_share = a_frames.get(frame, {}).get("total", 0.0) / a_total
+        b_share = b_frames.get(frame, {}).get("total", 0.0) / b_total
+        d = a_share - b_share
+        if abs(d) < 1e-9:
+            continue
+        deltas.append({"frame": frame, "before": round(b_share, 4),
+                       "after": round(a_share, 4), "delta": round(d, 4)})
+    deltas.sort(key=lambda r: (-r["delta"], r["frame"]))
+    regressions = [r for r in deltas if r["delta"] >= threshold]
+    out = {
+        "metric": "profile_diff",
+        "samples": [round(b_total, 3), round(a_total, 3)],
+        "threshold": threshold,
+        "regressions": regressions[:top],
+        "grown": deltas[:top],
+        "shrunk": list(reversed(deltas[-top:])),
+        "ok": not regressions,
+    }
+    return out, (1 if regressions else 0)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("capture", type=Path,
+                    help="profile capture (json or collapsed) or "
+                         "telemetry archive directory")
+    ap.add_argument("--diff", type=Path, metavar="BASELINE",
+                    help="flame-diff against this earlier capture; "
+                         "rc=1 when a frame's share grew >= threshold")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    metavar="SHARE",
+                    help="regression gate for --diff, in share of total "
+                         "samples (default 0.05)")
+    ap.add_argument("--top", type=int, default=10, metavar="N",
+                    help="rows per ranking (default 10)")
+    ap.add_argument("--plane", choices=("python", "native"),
+                    help="archive dirs only: keep one plane's windows")
+    ap.add_argument("--validate", action="store_true",
+                    help="parse gate only (CI smoke); nonzero unless at "
+                         "least one stack decodes")
+    args = ap.parse_args(argv)
+
+    agg = load(args.capture, plane=args.plane)
+    if args.validate:
+        if not agg:
+            raise SystemExit(f"{args.capture}: no profile stacks decoded")
+        print(json.dumps({"metric": "profile_report_validate", "ok": True,
+                          "stacks": len(agg)}))
+        return 0
+    if not agg:
+        raise SystemExit(f"{args.capture}: empty capture")
+    if args.diff is not None:
+        base = load(args.diff, plane=args.plane)
+        if not base:
+            raise SystemExit(f"{args.diff}: empty baseline capture")
+        out, rc = diff(agg, base, threshold=args.threshold, top=args.top)
+        print(json.dumps(out))
+        return rc
+    print(json.dumps(report(agg, top=args.top)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
