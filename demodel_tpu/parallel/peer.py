@@ -285,10 +285,11 @@ class PeerSet:
             if fresh_enough(cached):
                 return cached[0]
             try:
-                # demodel: allow(no-blocking-io-under-lock) — per-peer
-                # single-flight lock guarding exactly this download (a
-                # cold-cache fetch fan-out must not stampede /peer/index);
-                # the instance-wide self._lock is never held across it
+                # per-peer single-flight lock guarding exactly this
+                # download (a cold-cache fetch fan-out must not stampede
+                # /peer/index); the instance-wide self._lock is never
+                # held across it — lock-io recognizes the pattern now,
+                # so no allow() pragma is needed
                 r = request_with_retry(
                     self.session, "GET", f"{peer}/peer/index",
                     policy=self._policy, health=self._health, peer=peer,

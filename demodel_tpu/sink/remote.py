@@ -1360,15 +1360,25 @@ def _deliver_jobs_pipelined(jobs, mesh, plan, cast_to=None,
                     while admission["next"] != idx \
                             and not admission["dead"]:
                         admit_cv.wait()
+                got = False
                 try:
                     # charge before the bytes exist, so a worker blocks
                     # HERE rather than allocating past the budget;
                     # released after place()
                     budget.acquire(nbytes)
+                    got = True
                 finally:
-                    with admit_cv:
-                        admission["next"] = idx + 1
-                        admit_cv.notify_all()
+                    try:
+                        with admit_cv:
+                            admission["next"] = idx + 1
+                            admit_cv.notify_all()
+                    except BaseException:
+                        # the ticket is held by now: a raise on the
+                        # hand-over path must give it back or the
+                        # budget is down nbytes forever
+                        if got:
+                            budget.release(nbytes)
+                        raise
             try:
                 buf = np.empty(nbytes, dtype=np.uint8)
                 tuner_mod.fetch_windows(reader, key, buf, spec.start,

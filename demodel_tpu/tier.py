@@ -188,25 +188,35 @@ class HotTier:
             fd = os.open(path, os.O_RDONLY)
         except OSError:
             return False
+        mm = None
         try:
-            if size == 0:
-                return False  # nothing to map; zero-byte hits stay on disk
-            mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
-        except (OSError, ValueError):
-            return False
-        finally:
-            os.close(fd)
-        digest = hashlib.sha256(mm).hexdigest()
-        if not self._digest_matches(key, path, digest):
-            mm.close()
-            log.warning("hot-tier promotion refused: %s fails digest "
-                        "verification", key)
-            return False
-        with self._lock:
-            if key in self._objs:  # lost a promote race; keep the first
+            try:
+                if size == 0:
+                    return False  # nothing to map; zero-byte hits stay
+                    # on disk
+                mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+            except (OSError, ValueError):
+                return False
+            finally:
+                os.close(fd)
+            digest = hashlib.sha256(mm).hexdigest()
+            if not self._digest_matches(key, path, digest):
                 mm.close()
-                return True
-            self._objs[key] = _HotObj(mm, size, digest)
+                log.warning("hot-tier promotion refused: %s fails digest "
+                            "verification", key)
+                return False
+            with self._lock:
+                if key in self._objs:  # lost a promote race; keep the
+                    mm.close()         # first mapping
+                    return True
+                self._objs[key] = _HotObj(mm, size, digest)
+        except BaseException:
+            # the mapping is this frame's obligation until it is stored:
+            # a raise in close/hashing/verification must not strand a
+            # PROT_READ mapping of the whole object
+            if mm is not None:
+                mm.close()
+            raise
         self.budget.charge(size)
         _tick("store_tier_promotions_total", "ram")
         self.trim()

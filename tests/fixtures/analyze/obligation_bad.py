@@ -1,0 +1,141 @@
+"""Known obligation-leak violations; golden-tested by (rule, line).
+
+Each numbered case leaks a paired resource on some path. The controls
+at the bottom settle their obligations (finally, with, store, transfer
+to a releasing callee, split acquire/release discipline) and must stay
+silent. The pragma below points the native twin at the miniature fake
+native tree next door.
+"""
+# demodel: obligation-native=obligation_native
+
+import hashlib
+import mmap
+import os
+
+
+def discarded(path):
+    os.open(path, os.O_RDONLY)  # 1: result thrown away on the spot
+
+
+def never_settled(path):
+    fd = os.open(path, os.O_RDONLY)  # 2: no release on any path
+    return None
+
+
+def leaks_on_raise(path, n):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        mm = mmap.mmap(fd, n)  # 3: sha256 below may raise, mm leaks
+    finally:
+        os.close(fd)
+    digest = hashlib.sha256(mm).hexdigest()
+    mm.close()
+    return digest
+
+
+def _peek(v):
+    return v.fileno()
+
+
+def dropped_in_callee(path):
+    fd = os.open(path, os.O_RDONLY)  # 4: _peek neither releases nor keeps
+    _peek(fd)
+
+
+class Gate:
+    def __init__(self, cap):
+        self.quota_budget = cap
+
+    def admit(self, n):
+        self.quota_budget.charge(n)  # 5: nothing in the project releases
+
+
+def span_leaks(tracer, work):
+    span = tracer.start_span("load")  # 6: work() may raise before finish
+    out = work()
+    span.finish()
+    return out
+
+
+def writer_leaks(store, key, chunks):
+    w = store.begin(key)  # 7: append may raise before commit, no abort
+    for c in chunks:
+        w.append(c)
+    w.commit({})
+    return True
+
+
+def flight_leaks(flights, key, work):
+    flight, leader = flights.lease(key)  # 8: work() raise strands waiters
+    if not leader:
+        return None
+    out = work()
+    flight.finish(ok=True)
+    return out
+
+
+def response_leaks(session, url):
+    r = session.get(url, stream=True, timeout=5)  # 9: read may raise
+    body = r.raw.read()
+    r.close()
+    return body
+
+
+# ---- silent controls -------------------------------------------------
+
+
+def control_finally(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        return os.fstat(fd)
+    finally:
+        os.close(fd)
+
+
+def control_with(path, n):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        with mmap.mmap(fd, n) as mm:
+            return hashlib.sha256(mm).hexdigest()
+    finally:
+        os.close(fd)
+
+
+def control_stored(sink, path):
+    fd = os.open(path, os.O_RDONLY)
+    sink.fd = fd  # ownership moved: the sink releases it
+
+
+def _take(v):
+    v.close()
+
+
+def control_callee_releases(path):
+    fd = os.open(path, os.O_RDONLY)
+    _take(fd)  # resolved callee releases: a real transfer
+
+
+def control_returned(path):
+    return os.open(path, os.O_RDONLY)  # the caller inherits it
+
+
+class Pool:
+    def __init__(self, budget):
+        self.ram_budget = budget
+
+    def grab(self, n):
+        self.ram_budget.charge(n)  # split discipline: shed() releases
+
+    def shed(self, n):
+        self.ram_budget.release(n)
+
+
+def control_protected_writer(store, key, chunks):
+    w = store.begin(key)
+    try:
+        for c in chunks:
+            w.append(c)
+        w.commit({})
+    except BaseException:
+        w.abort()
+        raise

@@ -1,0 +1,48 @@
+// Silent controls: every obligation here settles — guarded
+// acquire-failure exits, RAII adoption, member stores, returns, and
+// cross-function pins (no local release) must produce NO findings.
+#include <fcntl.h>
+
+bool disciplined(const char *path, char *buf, long n) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return false;
+  long rc = pread(fd, buf, n, 0);
+  if (rc != n) {
+    ::close(fd);  // released before the error exit
+    return false;
+  }
+  ::close(fd);
+  return true;
+}
+
+void raii_adopted(const char *path) {
+  ScopedFd fd(::open(path, O_RDONLY));
+  use(fd.get());
+}
+
+struct Conn {
+  int fd_ = -1;
+  SSL *ssl_ = nullptr;
+};
+
+void stored_to_member(Conn *c, SSL_CTX *ctx) {
+  SSL *ssl = SSL_new(ctx);
+  if (!ssl) return;
+  c->ssl_ = ssl;  // the connection owns it now
+}
+
+int returned_to_caller(const char *path) {
+  int fd = ::open(path, O_RDONLY);
+  return fd;
+}
+
+const char *cross_function_pin(Store *s, const char *key) {
+  long sz = 0;
+  const char *m = s->hot_acquire(key, &sz);
+  return m;  // released by the caller at session close
+}
+
+void add_only_registration(int ep, int fd) {
+  struct epoll_event ev = {};
+  epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);  // long-lived: DEL at teardown
+}

@@ -1,0 +1,42 @@
+// Known native obligation leaks; exact (rule, line) golden-tested.
+// Each function leaks its paired resource on some path.
+#include <fcntl.h>
+
+bool early_exit_leak(const char *path, char *buf, long n) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return false;
+  long rc = pread(fd, buf, n, 0);
+  if (rc != n) return false;  // leaks fd on the short-read path
+  ::close(fd);
+  return true;
+}
+
+void never_released(const char *path) {
+  int fd = ::open(path, O_RDONLY);
+  (void)fd;
+}
+
+char *map_leak(int fd, long sz, long max) {
+  void *m = ::mmap(nullptr, sz, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (m == MAP_FAILED) return nullptr;
+  if (sz > max) return nullptr;  // leaks the mapping
+  ::munmap(m, sz);
+  return nullptr;
+}
+
+int handshake_leak(SSL_CTX *ctx, long deadline) {
+  SSL *ssl = SSL_new(ctx);
+  if (!ssl) return -1;
+  if (deadline <= 0) return -1;  // leaks ssl on the timeout path
+  SSL_free(ssl);
+  return 0;
+}
+
+void pin_leak(Store *s, const char *key, char *out, long n) {
+  long sz = 0;
+  const char *m = s->hot_acquire(key, &sz);
+  if (!m) return;
+  if (sz < n) return;  // leaks the pin on the short-object path
+  memcpy(out, m, n);
+  s->hot_release(key);
+}

@@ -45,6 +45,8 @@ ALL_RULES = {
     "guarded-field",
     "atomic-snapshot",
     "surface-parity",
+    # the PR 16 obligation plane
+    "obligation-leak",
 }
 
 #: fixture file → exact expected (rule, line) findings
@@ -175,6 +177,29 @@ GOLDEN = {
         ("surface-parity", 22),   # rank mirror: drift/stale/missing
         ("surface-parity", 7),    # parity_native/lock_order.h: dup rank
         ("surface-parity", 8),    # parity_native/proxy.cc: unwindowed hist
+    },
+    # the obligation plane: every paired-resource leak shape on the
+    # Python side (discarded, never settled, leaks-on-raise across five
+    # resource kinds, dropped-by-callee, unpaired budget receiver), and
+    # the native twin over the miniature fake tree in obligation_native/
+    # (fd/mmap/SSL early-exit leaks, a never-released fd, a dropped hot
+    # pin); the silent controls in both files are half the contract
+    "obligation_bad.py": {
+        ("obligation-leak", 17),  # discarded acquire
+        ("obligation-leak", 21),  # never settled
+        ("obligation-leak", 28),  # mmap leaks if sha256 raises
+        ("obligation-leak", 41),  # callee provably drops the fd
+        ("obligation-leak", 50),  # budget receiver never released
+        ("obligation-leak", 54),  # span leaks if work() raises
+        ("obligation-leak", 61),  # writer leaks if append raises
+        ("obligation-leak", 69),  # flight leaks if work() raises
+        ("obligation-leak", 78),  # streamed response leaks on read
+        ("obligation-leak", 6),   # obligation_native/leaky.cc: fd exit
+        ("obligation-leak", 15),  # leaky.cc: fd never released
+        ("obligation-leak", 20),  # leaky.cc: mmap early exit
+        ("obligation-leak", 28),  # leaky.cc: SSL early exit (line shared
+        #                           with the py mmap case above — sets)
+        ("obligation-leak", 37),  # leaky.cc: dropped hot pin
     },
     # the cross-module taint pair: silent when analyzed alone (neither
     # half shows both the device producer and the sync) — the findings
@@ -849,3 +874,143 @@ def test_surface_parity_cache_key_digests_native_inputs(tmp_path):
         ln for ln in edited.stdout.splitlines() if "= 7 but" in ln), \
         "mirror now matches: the rank-drift finding must be gone"
     assert edited.stdout != cold.stdout
+
+
+# ---- the obligation plane (PR 16) -----------------------------------
+
+
+def test_obligation_cross_module_transfer_stays_silent(tmp_path):
+    """Ownership transfer composes across modules: the acquiring module
+    hands the fd to a callee defined ELSEWHERE whose summary releases
+    it — no finding anywhere."""
+    (tmp_path / "janitor.py").write_text(
+        "import os\n"
+        "def take(v):\n"
+        "    os.close(v)\n"
+    )
+    (tmp_path / "opener.py").write_text(
+        "import os\n"
+        "import janitor\n"
+        "def load(path):\n"
+        "    fd = os.open(path, os.O_RDONLY)\n"
+        "    janitor.take(fd)\n"
+    )
+    active, _ = analyze_paths(
+        [tmp_path / "janitor.py", tmp_path / "opener.py"],
+        rule_ids=["obligation-leak"], root=tmp_path)
+    assert active == [], [str(f) for f in active]
+
+
+def test_obligation_dropped_in_callee_blames_acquire_site(tmp_path):
+    """A callee that neither releases nor keeps the resource drops the
+    obligation — the finding lands on the CALLER's acquire line and
+    names the guilty callee, Infer-style."""
+    (tmp_path / "peeker.py").write_text(
+        "def peek(v):\n"
+        "    return v.fileno()\n"
+    )
+    (tmp_path / "opener.py").write_text(
+        "import os\n"
+        "import peeker\n"
+        "def load(path):\n"
+        "    fd = os.open(path, os.O_RDONLY)\n"
+        "    peeker.peek(fd)\n"
+    )
+    active, _ = analyze_paths(
+        [tmp_path / "peeker.py", tmp_path / "opener.py"],
+        rule_ids=["obligation-leak"], root=tmp_path)
+    assert len(active) == 1, [str(f) for f in active]
+    f = active[0]
+    assert (f.rule, f.path, f.line) == ("obligation-leak", "opener.py", 4)
+    assert "peek" in f.message
+
+
+def test_obligation_cache_key_digests_native_inputs(tmp_path):
+    """obligation-leak reads the anchored native tree in finalize(), so
+    those files must be part of its cache key — and edits to them must
+    NOT invalidate rules that never look at native code."""
+    import os
+    import shutil
+
+    import tools.analyze.passes  # noqa: F401 — registry
+    from tools.analyze import cache
+
+    shutil.copy(FIXTURES / "obligation_bad.py",
+                tmp_path / "obligation_bad.py")
+    shutil.copytree(FIXTURES / "obligation_native",
+                    tmp_path / "obligation_native")
+    files = [tmp_path / "obligation_bad.py"]
+
+    before = {rid: cache.rule_key(files, rid, None)
+              for rid in ("obligation-leak", "no-bare-except")}
+    cc = tmp_path / "obligation_native" / "leaky.cc"
+    st = cc.stat()
+    os.utime(cc, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    after = {rid: cache.rule_key(files, rid, None)
+             for rid in ("obligation-leak", "no-bare-except")}
+    assert before["obligation-leak"] != after["obligation-leak"]
+    assert before["no-bare-except"] == after["no-bare-except"]
+
+
+def test_obligation_native_suppression_via_slash_comment(tmp_path):
+    """`// demodel: allow(obligation-leak)` on (or right above) the
+    acquire line silences the native finding — the pragma grammar works
+    in C++ comments, not just Python ones."""
+    import shutil
+
+    shutil.copy(FIXTURES / "obligation_bad.py",
+                tmp_path / "obligation_bad.py")
+    shutil.copytree(FIXTURES / "obligation_native",
+                    tmp_path / "obligation_native")
+    cc = tmp_path / "obligation_native" / "leaky.cc"
+    cc.write_text(cc.read_text().replace(
+        "  int fd = ::open(path, O_RDONLY);\n  if (fd < 0) return false;",
+        "  int fd = ::open(path, O_RDONLY);  "
+        "// demodel: allow(obligation-leak) fixture\n"
+        "  if (fd < 0) return false;", 1))
+    active, suppressed = analyze_paths(
+        [tmp_path / "obligation_bad.py"],
+        rule_ids=["obligation-leak"], root=tmp_path)
+    lines = {f.line for f in active if f.path.endswith("leaky.cc")}
+    assert 6 not in lines, "the allow pragma must silence line 6"
+    assert any(f.line == 6 and f.path.endswith("leaky.cc")
+               for f in suppressed)
+
+
+def test_check_suppressions_flags_stale_pragma(tmp_path):
+    """An allow() whose rule no longer fires on its lines fails the
+    audit — dead pragmas are holes for future regressions."""
+    (tmp_path / "mod.py").write_text(
+        "def fine():\n"
+        "    return 1  # demodel: allow(no-bare-except) historic, fixed\n"
+    )
+    res = _run_cli(["--check-suppressions", "mod.py"], tmp_path)
+    assert res.returncode == 1
+    assert "is stale" in res.stderr
+
+
+def test_check_suppressions_live_pragma_passes(tmp_path):
+    """A justified pragma that is actually suppressing a finding is NOT
+    stale — the audit keys on the suppressed list, not on vibes."""
+    (tmp_path / "mod.py").write_text(
+        "def risky():\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except:  # demodel: allow(no-bare-except) fixture needs it\n"
+        "        return 0\n"
+    )
+    res = _run_cli(["--check-suppressions", "mod.py"], tmp_path)
+    assert res.returncode == 0, res.stderr
+    assert "is stale" not in res.stderr
+
+
+def test_check_suppressions_skips_unrun_rules(tmp_path):
+    """Under a --rule subset, pragmas for rules that never ran cannot
+    be judged stale — absence of findings means nothing there."""
+    (tmp_path / "mod.py").write_text(
+        "def fine():\n"
+        "    return 1  # demodel: allow(no-bare-except) historic, fixed\n"
+    )
+    res = _run_cli(["--check-suppressions", "--rule", "jit-hygiene",
+                    "mod.py"], tmp_path)
+    assert res.returncode == 0, res.stderr

@@ -12,8 +12,11 @@ Modes on top of the plain run:
   findings in a changed file keep firing;
 - ``--stats`` — per-rule finding/suppression counts and files/s;
 - ``--check-suppressions`` — every inline ``# demodel: allow(rule)``
-  must carry a justification (text after the allow); violations fail
-  the run, so the suppression count cannot grow reason-free;
+  must carry a justification (text after the allow), and every pragma
+  must still be EARNING its keep: an allow whose rule no longer fires
+  on any line it covers is stale and fails the run (dead pragmas
+  silently bless future regressions); only rules that actually ran are
+  audited, so ``--rule`` subsets never produce false staleness;
 - results are cached (``.demodel-analyze-cache.json``) keyed on every
   analyzed file's (path, mtime, size) plus the analyzer's own sources —
   ``--no-cache`` forces a cold run.
@@ -72,6 +75,8 @@ def check_suppressions(files) -> list[str]:
             m = SUPPRESS_RE.search(line)
             if not m:
                 continue
+            if m.start() > 0 and line[m.start() - 1] == "`":
+                continue  # doc MENTION of the grammar, not a pragma
             reason = line[m.end():].strip().strip("—-–: ").strip()
             # comment-block form: the justification may span the
             # following comment-only lines — accumulate them all, so a
@@ -86,6 +91,65 @@ def check_suppressions(files) -> list[str]:
                            "justification — say why this pattern is "
                            "deliberate")
     return bad
+
+
+def stale_suppressions(files, suppressed, run_rules, root) -> list[str]:
+    """Inline allows whose rule no longer fires on any line they cover.
+
+    A pragma proves its worth by appearing in the suppressed-findings
+    list; one that suppresses nothing is a hole waiting for a real
+    finding to fall through. Coverage mirrors ``core.suppressions`` /
+    ``core.is_suppressed`` exactly: the pragma's own line (plus the
+    comment-block extension for comment-only allows), matched against
+    each finding's line and the line above it. Pragmas none of whose
+    rules were run this invocation are skipped — absence of findings
+    means nothing for a rule that never looked.
+    """
+    by_path: dict[str, list] = {}
+    for f in suppressed:
+        by_path.setdefault(f.path, []).append(f)
+    run = set(run_rules)
+    out: list[str] = []
+    for path in files:
+        p = Path(path)
+        try:
+            rel = p.resolve().relative_to(Path(root).resolve()).as_posix()
+        except ValueError:
+            rel = p.as_posix()
+        try:
+            lines = p.read_text(
+                encoding="utf-8", errors="replace").splitlines()
+        except OSError:
+            continue
+        hits = by_path.get(rel, [])
+        for i, line in enumerate(lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            if m.start() > 0 and line[m.start() - 1] == "`":
+                continue  # backtick-quoted doc mention, not a pragma
+            ids = {t.strip() for t in m.group(1).split(",") if t.strip()}
+            ids = ids or {"*"}
+            if "*" not in ids and not (ids & run):
+                continue
+            cov = {i}
+            if line.strip().startswith(("#", "/")):
+                j = i + 1
+                while j <= len(lines) and (
+                        not lines[j - 1].strip()
+                        or lines[j - 1].strip().startswith("#")):
+                    cov.add(j)
+                    j += 1
+            live = any(
+                (f.line in cov or f.line - 1 in cov)
+                and ("*" in ids or f.rule in ids)
+                for f in hits)
+            if not live:
+                out.append(
+                    f"{rel}:{i} allow({m.group(1)}) is stale — the rule "
+                    "no longer fires on the lines it covers; remove the "
+                    "pragma so a future regression cannot hide under it")
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -200,7 +264,23 @@ def main(argv: list[str] | None = None) -> int:
 
     bad_sup: list[str] = []
     if args.check_suppressions:
-        bad_sup = check_suppressions(files)
+        audit = list(files)
+        native_dir = root / "native"
+        if native_dir.is_dir():
+            # // demodel: allow(...) pragmas live in the native plane
+            # too; audit them alongside the Python ones
+            audit += sorted(native_dir.glob("*.h"))
+            audit += sorted(native_dir.glob("*.cc"))
+        bad_sup = check_suppressions(audit)
+        if report_only is None:
+            # staleness needs the FULL suppressed list: under
+            # --changed-only the filtered view would flag every pragma
+            # in an untouched file
+            import tools.analyze.passes  # noqa: F401 — populate REGISTRY
+
+            run_rules = set(args.rule) if args.rule else set(REGISTRY)
+            bad_sup += stale_suppressions(
+                audit, suppressed, run_rules, root)
         for b in bad_sup:
             print(b, file=sys.stderr)
 

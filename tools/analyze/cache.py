@@ -47,6 +47,8 @@ PARSE_RULE = "__parse__"
 _SHARED = [
     _TOOL_DIR / "core.py",
     _TOOL_DIR / "index.py",
+    _TOOL_DIR / "obligations.py",
+    _TOOL_DIR / "native_index.py",
     _TOOL_DIR / "cache.py",
     _TOOL_DIR / "sarif.py",
     _TOOL_DIR / "__main__.py",

@@ -22,7 +22,7 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Iterable, Iterator
 
-SUPPRESS_RE = re.compile(r"#\s*demodel:\s*allow\(([^)]*)\)")
+SUPPRESS_RE = re.compile(r"(?:#|//)\s*demodel:\s*allow\(([^)]*)\)")
 HOT_PRAGMA_RE = re.compile(r"#\s*demodel:\s*hot-path")
 
 #: delivery hot-path packages — the host-sync rule applies only here (plus
@@ -206,7 +206,7 @@ def suppressions(source: str) -> dict[int, set[str]]:
         ids = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
         ids = ids or {"*"}
         add(i, ids)
-        if line.strip().startswith("#"):
+        if line.strip().startswith(("#", "//")):
             # comment-only allow: extend through the comment block to the
             # first code line
             j = i + 1
@@ -303,9 +303,22 @@ def analyze_paths(
     for ctx in contexts:
         for p in passes:
             bucket(p.visit(ctx), sups[ctx.rel])
+    def sup_for(rel: str) -> dict[int, set[str]]:
+        # finalize findings can land on files OUTSIDE the analyzed .py
+        # set (the native plane): load their pragmas lazily so
+        # `// demodel: allow(rule)` works there too
+        if rel not in sups:
+            try:
+                text = (root / rel).read_text(encoding="utf-8",
+                                              errors="replace")
+            except OSError:
+                text = ""
+            sups[rel] = suppressions(text)
+        return sups[rel]
+
     for p in passes:
         for f in p.finalize():
-            bucket([f], sups.get(f.path, {}))
+            bucket([f], sup_for(f.path))
     if report_only is not None:
         active = [f for f in active if f.path in report_only]
         suppressed = [f for f in suppressed if f.path in report_only]

@@ -207,6 +207,19 @@ class FunctionInfo:
     #: section, so feeding it into the lock-order graph would fabricate
     #: cycles.
     submit_calls: list = field(default_factory=list)
+    #: acquires-obligation facts: every paired-resource acquire in the
+    #: body with its local settle/escape/risk classification
+    #: (tools.analyze.obligations.ObligationSite)
+    obligations: list = field(default_factory=list)
+    #: transfers-ownership facts: param name → first ownership event —
+    #: ("released", line) / ("kept", how, line) / ("forwarded", callee
+    #: qname, callee param, line) / ("dropped",) — what lets a caller's
+    #: handoff compose through the call graph at bounded depth
+    param_fate: dict = field(default_factory=dict)
+    #: releases-obligation facts: receiver dotted texts this body calls
+    #: a release-shaped method on (``self.budget`` when the body has
+    #: ``self.budget.release(...)``) — the receiver-carried discipline
+    released_receivers: set = field(default_factory=set)
 
 
 class ProjectIndex:
@@ -399,6 +412,11 @@ class ProjectIndex:
                         info.returns_device_direct = True
                     elif val.id in call_assigned:
                         info.returns_calls.add(call_assigned[val.id])
+            # obligation facts ride the same pass: one extra scoped walk
+            # per body, resolution map already populated above
+            from tools.analyze import obligations
+
+            obligations.collect(ctx, node, info, self)
 
     def _cls_node(self, ctx: "ModuleContext",
                   info: FunctionInfo) -> ast.ClassDef | None:
@@ -428,6 +446,11 @@ class ProjectIndex:
         if parts[0] in aliases:
             return ".".join([aliases[parts[0]]] + parts[1:])
         return f"{ctx.module}.{name}"
+
+    def resolve_class(self, ctx: "ModuleContext", name: str) -> str | None:
+        """Resolve ``name`` to a project class qname, or None."""
+        q = self._resolve_name(ctx, name)
+        return q if q in self.classes else None
 
     def _resolve(self, ctx: "ModuleContext", fn: ast.AST, call: ast.Call,
                  local_types: dict[str, str]) -> str | None:

@@ -1,0 +1,409 @@
+"""Regex-level native index: function bodies + obligation events.
+
+surface-parity's extractor reads a handful of named definitions out of
+``native/*.{h,cc}``; the obligation rule needs more — every function
+body, with enough statement structure to run the same
+acquire/release/transfer discipline the Python plane gets from the AST.
+This stays deliberately clang-free: comments and string literals are
+blanked (offsets preserved), function bodies are found by brace
+matching behind a ``) {`` opener, and statements are split on
+``;``/``{``/``}`` with a condition stack so an early ``return`` knows
+which ``if`` guards it.
+
+RAII is a first-class discharge: a ``unique_ptr``/``lock_guard``/
+``absl::Cleanup``-shaped wrapper on the acquire statement (or later
+adoption of the value) settles the obligation. Everything the regex
+level cannot prove — the value passed to another function, stored to a
+member, returned — is an ownership transfer and stays silent, the same
+no-speculative-edges posture as the Python index.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterator
+
+# tokens whose presence on a statement means the resource is owned by a
+# scope guard — destructor discharges the obligation
+RAII_RE = re.compile(
+    r"\b(?:unique_ptr|shared_ptr|lock_guard|unique_lock|scoped_lock|"
+    r"Cleanup|Defer|ScopeGuard|ScopedFd|FdCloser)\b")
+
+_KEYWORDS = {"if", "while", "for", "switch", "catch", "return", "sizeof",
+             "defined", "assert", "static_assert", "alignof", "decltype"}
+
+_FN_OPEN_RE = re.compile(
+    r"\)\s*(?:const\b|noexcept\b|override\b|final\b|\s|->\s*[\w:<>&*\s]+?)*\{")
+
+_INLINE_GUARD_RE = re.compile(
+    r"\bif\s*\((?P<cond>.*)\)\s*(?P<tail>return\b|throw\b|goto\b|"
+    r"continue\b|break\b)", re.DOTALL)
+
+_EXIT_RE = re.compile(r"\b(return|throw|goto)\b")
+
+
+def strip_code(text: str) -> str:
+    """Blank comments, string and char literals — offsets preserved so
+    line numbers computed over the result match the original."""
+
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            j = n if j < 0 else j
+            out.append(" " * (j - i))
+            i = j
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append(re.sub(r"[^\n]", " ", text[i:j]))
+            i = j
+        elif c in "\"'":
+            j = i + 1
+            while j < n and text[j] != c:
+                j += 2 if text[j] == "\\" else 1
+            j = min(j + 1, n)
+            out.append(c + " " * (j - i - 2) + (c if j - i >= 2 else ""))
+            i = j
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+@dataclass
+class Statement:
+    line: int
+    text: str
+    #: conditions of every enclosing block inside the function (plus the
+    #: inline guard when the statement is `if (c) return;`)
+    conds: list = field(default_factory=list)
+
+
+@dataclass
+class NativeFunction:
+    name: str
+    rel: str
+    line: int
+    body: str
+    statements: list = field(default_factory=list)
+
+
+def _match_name(text: str, close_paren: int) -> str:
+    """The identifier before the ``(`` matching ``)`` at close_paren."""
+    depth = 0
+    i = close_paren
+    while i >= 0:
+        if text[i] == ")":
+            depth += 1
+        elif text[i] == "(":
+            depth -= 1
+            if depth == 0:
+                break
+        i -= 1
+    if i < 0:
+        return ""
+    m = re.search(r"([A-Za-z_][\w:~]*)\s*$", text[:i])
+    return m.group(1) if m else ""
+
+
+def _balanced(text: str, open_brace: int) -> int:
+    """Offset just past the ``}`` matching ``{`` at open_brace."""
+    depth = 0
+    for i in range(open_brace, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def _statements(body: str, base_pos: int, text: str) -> list:
+    """Flat statement list with per-statement condition stacks."""
+    out: list[Statement] = []
+    stack: list[str] = []
+    pos = 0
+    for m in re.finditer(r"[;{}]", body):
+        chunk = body[pos:m.start()]
+        stripped = chunk.strip()
+        ch = m.group()
+        line = _line_of(text, base_pos + pos + (len(chunk) - len(chunk.lstrip())))
+        if ch == ";":
+            if stripped:
+                st = Statement(line, stripped, list(stack))
+                g = _INLINE_GUARD_RE.search(stripped)
+                if g:
+                    st.conds.append(g.group("cond"))
+                out.append(st)
+        elif ch == "{":
+            cm = re.search(r"\b(?:if|while|for|switch)\s*\((.*)\)\s*$",
+                           stripped, re.DOTALL)
+            stack.append(cm.group(1) if cm else "")
+            if stripped and not cm:
+                # `do {`, `else {`, struct literals — opaque block
+                pass
+        else:  # "}"
+            if stripped:
+                out.append(Statement(line, stripped, list(stack)))
+            if stack:
+                stack.pop()
+        pos = m.end()
+    return out
+
+
+def extract_functions(path: Path, rel: str) -> Iterator[NativeFunction]:
+    try:
+        raw = path.read_text(encoding="utf-8", errors="replace")
+    except OSError:
+        return
+    text = strip_code(raw)
+    pos = 0
+    while True:
+        m = _FN_OPEN_RE.search(text, pos)
+        if not m:
+            return
+        open_brace = m.end() - 1
+        name = _match_name(text, text.rfind(")", m.start(), open_brace + 1))
+        if not name or name.rsplit("::", 1)[-1] in _KEYWORDS:
+            pos = m.end()
+            continue
+        end = _balanced(text, open_brace)
+        body = text[open_brace + 1:end - 1]
+        fn = NativeFunction(name, rel, _line_of(text, m.start()), body)
+        fn.statements = _statements(body, open_brace + 1, text)
+        yield fn
+        pos = end
+
+
+# ----------------------------------------------------------- resource pairs
+
+
+@dataclass(frozen=True)
+class NativePair:
+    kind: str
+    label: str
+    acquire_re: re.Pattern
+    release_token: str          # bare callee name of the release
+    #: "result" — track the assigned variable; "arg" — track the acquire
+    #: call's first argument text (key-matched pins/registrations)
+    entity: str = "result"
+    #: only analyze functions that call the release at least once —
+    #: resources legitimately held across functions (session pins,
+    #: epoll registrations) otherwise drown the rule in false leaks
+    needs_local_release: bool = False
+    #: skip the "never released anywhere" check (pairs whose release
+    #: legitimately lives in another function)
+    check_missing: bool = True
+
+
+NATIVE_PAIRS = (
+    NativePair("mmap", "mmap mapping (release: munmap)",
+               re.compile(r"(?<![\w.])mmap\s*\("), "munmap"),
+    NativePair("fd", "file descriptor (release: close)",
+               re.compile(r"(?<![\w.:])(?:::\s*)?open\s*\("), "close"),
+    NativePair("ssl", "SSL handle (release: SSL_free)",
+               re.compile(r"\bSSL_new\s*\("), "SSL_free"),
+    NativePair("hot-pin", "hot-tier pin (release: hot_release)",
+               re.compile(r"\bhot_acquire\s*\("), "hot_release",
+               entity="arg", needs_local_release=True, check_missing=False),
+    NativePair("epoll", "epoll registration (release: EPOLL_CTL_DEL)",
+               re.compile(r"\bepoll_ctl\s*\(\s*[^,]+,\s*EPOLL_CTL_ADD"),
+               "EPOLL_CTL_DEL", entity="arg", needs_local_release=True,
+               check_missing=False),
+)
+
+
+def _first_arg(text: str, call_end: int) -> str:
+    """First top-level argument of the call whose ``(`` is at
+    call_end-1 — the key a pin/registration is matched on."""
+    depth, i, start = 1, call_end, call_end
+    while i < len(text) and depth:
+        c = text[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        elif c == "," and depth == 1:
+            break
+        i += 1
+    return re.sub(r"\s+", "", text[start:i])
+
+
+def _epoll_fd_arg(stmt: str) -> str:
+    """The fd (third) argument of an epoll_ctl ADD statement."""
+    m = re.search(r"epoll_ctl\s*\(([^;]*)", stmt)
+    if not m:
+        return ""
+    parts = [p.strip() for p in m.group(1).split(",")]
+    return re.sub(r"\s+", "", parts[2]) if len(parts) >= 3 else ""
+
+
+@dataclass
+class NativeObligation:
+    """One native acquire with its locally-decided fate — mirrors the
+    Python plane's ObligationSite closely enough for one shared rule."""
+
+    kind: str
+    label: str
+    rel: str
+    line: int
+    entity: str
+    fn_name: str
+    #: (line, stmt text) of an unguarded early exit between the acquire
+    #: and the function's release of the entity
+    leak_exit: tuple | None = None
+    #: nothing in the function releases, stores, returns, RAII-adopts,
+    #: or forwards the entity
+    never_settled: bool = False
+
+
+def _entity_in(stmt: str, entity: str) -> bool:
+    return re.search(r"(?<![\w.])%s\b" % re.escape(entity), stmt) is not None
+
+
+def _bound_var(stmt: str, acq: re.Match) -> str:
+    head = stmt[:acq.start()]
+    m = re.search(r"([A-Za-z_]\w*)\s*=\s*[^=]*$", head)
+    return m.group(1) if m else ""
+
+
+def _member_store(stmt: str, entity: str) -> bool:
+    pat = r"(?:\w+_|[\w\)\]]+(?:\.|->)\w+)\s*=\s*[^=]*(?<![\w.])%s\b" % \
+        re.escape(entity)
+    return re.search(pat, stmt) is not None
+
+
+#: callees that USE a descriptor/pointer without taking ownership —
+#: passing the entity here is not a transfer, so an early exit after a
+#: failed pwrite still counts as the leak it is
+_NON_OWNING = {
+    "read", "write", "pread", "pwrite", "readv", "writev", "lseek",
+    "fstat", "stat", "ftruncate", "fallocate", "fsync", "fdatasync",
+    "flock", "fcntl", "ioctl", "msync", "madvise", "mprotect", "memcpy",
+    "memcmp", "memmove", "dup", "dup2", "posix_fadvise", "mmap",
+    "CHECK", "assert", "printf", "fprintf", "snprintf", "perror",
+}
+
+
+def _close_of(text: str, open_paren: int) -> int:
+    depth = 0
+    for i in range(open_paren, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(text)
+
+
+def _passed_to_call(stmt: str, entity: str) -> bool:
+    """Entity handed to a callee that might take ownership."""
+    for m in re.finditer(r"([A-Za-z_][\w:]*)\s*\(", stmt):
+        name = m.group(1).rsplit("::", 1)[-1]
+        if name in _NON_OWNING or name in _KEYWORDS:
+            continue
+        inner = stmt[m.end():_close_of(stmt, m.end() - 1)]
+        if re.search(r"(?<![\w.])%s\b" % re.escape(entity), inner):
+            return True
+    return False
+
+
+def scan_function(fn: NativeFunction) -> Iterator[NativeObligation]:
+    body_has = {p.kind: p.release_token in fn.body for p in NATIVE_PAIRS}
+    stmts = fn.statements
+    for si, st in enumerate(stmts):
+        for pair in NATIVE_PAIRS:
+            acq = pair.acquire_re.search(st.text)
+            if acq is None:
+                continue
+            if pair.needs_local_release and not body_has[pair.kind]:
+                continue
+            if RAII_RE.search(st.text):
+                continue  # scope guard adopts it on the spot
+            resvar = _bound_var(st.text, acq)
+            if pair.entity == "arg":
+                if pair.kind == "epoll":
+                    entity = _epoll_fd_arg(st.text)
+                else:
+                    entity = _first_arg(st.text, acq.end())
+                if not entity:
+                    continue
+            else:
+                entity = resvar
+                if not entity:
+                    # `return mmap(...)` / `use(SSL_new(...))` — the
+                    # value moved somewhere we cannot track: transfer
+                    continue
+            # guards on an arg-carried pin test the RESULT variable
+            # (`if (!m) return` after `m = hot_acquire(key, ...)`) —
+            # acquire-failure exits must know both names
+            guards = {entity} | ({resvar} if resvar else set())
+            yield from _judge(fn, pair, entity, guards, st, stmts[si + 1:])
+
+
+def _judge(fn: NativeFunction, pair: NativePair, entity: str,
+           guards: set, acquire: Statement,
+           rest: list) -> Iterator[NativeObligation]:
+    release_rx = re.compile(r"\b%s\s*\(" % re.escape(pair.release_token))
+    first_settle = None       # index into rest of release/transfer
+    release_anywhere = False
+    transfer_anywhere = False
+    for i, st in enumerate(rest):
+        if release_rx.search(st.text) and (
+                pair.entity == "arg" and
+                re.sub(r"\s+", "", st.text).find(entity) >= 0
+                or pair.entity == "result" and _entity_in(st.text, entity)):
+            release_anywhere = True
+            if first_settle is None:
+                first_settle = i
+            continue
+        if pair.entity != "result":
+            continue
+        if not _entity_in(st.text, entity):
+            continue
+        if (re.search(r"\breturn\b[^;]*(?<![\w.])%s\b" % re.escape(entity),
+                      st.text)
+                or _member_store(st.text, entity)
+                or RAII_RE.search(st.text)
+                or re.search(r"\bstd::move\s*\(\s*%s\b" % re.escape(entity),
+                             st.text)
+                or _passed_to_call(st.text, entity)):
+            transfer_anywhere = True
+            if first_settle is None:
+                first_settle = i
+
+    site = NativeObligation(pair.kind, pair.label, fn.rel, acquire.line,
+                            entity, fn.name)
+    if first_settle is None:
+        if pair.check_missing and not release_anywhere \
+                and not transfer_anywhere:
+            site.never_settled = True
+            yield site
+        return
+    if not release_anywhere:
+        return  # settled by transfer: someone else's obligation now
+    # early-exit check: an unguarded return/throw strictly before the
+    # first release/transfer leaks the entity on that path
+    for st in rest[:first_settle]:
+        em = _EXIT_RE.search(st.text)
+        if not em:
+            continue
+        if any(re.search(r"\breturn\b[^;]*(?<![\w.])%s\b" % re.escape(g),
+                         st.text) for g in guards):
+            continue  # returning the entity (or its pin) is a transfer
+        if any(c and _entity_in(c, g) for c in st.conds for g in guards):
+            continue  # guarded on the entity: acquire-failure path
+        site.leak_exit = (st.line, st.text[:60])
+        yield site
+        return

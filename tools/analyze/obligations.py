@@ -1,0 +1,540 @@
+"""Obligation facts: must-release resource tracking per function.
+
+The Infer/Pulse "must-call" shape, grafted onto the ProjectIndex: a
+declared table of paired resources (budget tickets, flight leases,
+store partial writers, fds, mmaps, streamed HTTP responses, spans) and
+one bottom-up walk per function that records, for every acquire site,
+
+- **acquires-obligation** — the resource kind, the bound entity, and
+  the acquire line (the blame anchor);
+- **releases-obligation** — where the entity's normal path settles: a
+  release-method call (``close``/``commit``/``abort``/``finish``/…), a
+  ``with`` entry, or ``os.close(fd)``;
+- **transfers-ownership** — escapes that move the obligation to
+  someone else: returned to the caller, stored into ``self``/a
+  container/an alias, captured by a nested def, handed to a known
+  owner-taking callable, or passed to a *resolved* project callee
+  (recorded as a pending edge the pass composes through the call graph
+  at bounded depth — a callee that provably drops the entity is NOT a
+  transfer, and the blame lands back on the acquire site).
+
+Path sensitivity is the protected-region check: may-raise statements
+between the acquire and its first settle point must sit under a
+``try`` whose ``finally`` or handler discharges the entity (or the
+acquire must be a ``with`` item). Everything unresolved is
+under-approximated in the silent direction — no speculative leaks —
+mirroring the index's no-speculative-edges contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Iterator
+
+from tools.analyze.core import dotted, walk_in_scope
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from tools.analyze.core import ModuleContext
+    from tools.analyze.index import FunctionInfo, ProjectIndex
+
+BUDGETISH_RE = re.compile(r"budget", re.IGNORECASE)
+FLIGHTISH_RE = re.compile(r"flight", re.IGNORECASE)
+STOREISH_RE = re.compile(r"store", re.IGNORECASE)
+TRACEISH_RE = re.compile(r"trace|tracer", re.IGNORECASE)
+
+_HTTP_VERBS = {"get", "post", "put", "patch", "delete", "head", "request"}
+
+#: callables that take OWNERSHIP of the argument (releasing it becomes
+#: their problem) — passing the entity here settles the obligation
+OWNER_TAKING = {"os.fdopen", "closing", "contextlib.closing"}
+
+#: container methods: the entity now lives in a collection/queue whose
+#: owner inherits the obligation (the streaming sink's ticket handoff)
+CONTAINER_SINKS = {"append", "add", "put", "put_nowait", "setdefault",
+                   "register", "push", "insert", "appendleft"}
+
+#: calls that cannot realistically raise — excluded from the risk
+#: region so a log line between acquire and release is not "a leak"
+_SAFE_EXACT = {"len", "min", "max", "isinstance", "hasattr", "getattr",
+               "int", "float", "str", "bytes", "bool", "id", "repr",
+               "time.time", "time.monotonic", "time.perf_counter",
+               "_tick", "print"}
+_SAFE_PREFIXES = ("log.", "logger.", "logging.", "warnings.")
+
+
+@dataclass(frozen=True)
+class Resource:
+    kind: str              # short id used in blame messages
+    label: str             # human phrase naming the pair
+    releases: frozenset    # method names that discharge the obligation
+    carrier: str = "result"  # "result" (bound value) | "receiver"
+
+
+_FD = Resource("fd", "os.open file descriptor (release: os.close)",
+               frozenset({"close"}))
+_MMAP = Resource("mmap", "mmap mapping (release: .close())",
+                 frozenset({"close"}))
+_WRITER = Resource(
+    "store-writer",
+    "store partial writer (release: .commit() or .abort())",
+    frozenset({"commit", "abort", "close"}))
+_FLIGHT = Resource(
+    "flight", "single-flight lease (release: .finish() or .resign())",
+    frozenset({"finish", "resign"}))
+_BUDGET = Resource(
+    "budget", "budget ticket (release: .release() or .abort())",
+    frozenset({"release", "abort"}), carrier="receiver")
+_RESPONSE = Resource(
+    "response", "streamed HTTP response (release: .close())",
+    frozenset({"close", "release_conn"}))
+_SPAN = Resource("span", "span (release: .finish()/.end())",
+                 frozenset({"finish", "end", "close"}))
+
+#: every release-ish method name any tracked resource recognizes — the
+#: generic set used when judging how a callee treats a PARAMETER
+ANY_RELEASE = frozenset().union(*(r.releases for r in (
+    _FD, _MMAP, _WRITER, _FLIGHT, _BUDGET, _RESPONSE, _SPAN)))
+
+
+def classify_acquire(call: ast.Call, recv_src: str,
+                     resolved: str | None) -> Resource | None:
+    """The resource a call acquires, or None. Recognition is
+    receiver-shaped (name pattern or index-resolved class) — the same
+    two levels the budget-charge summary already uses."""
+    name = dotted(call.func) or ""
+    if name == "os.open":
+        return _FD
+    if name == "mmap.mmap" or name.endswith(".mmap.mmap"):
+        return _MMAP
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    res_l = (resolved or "").lower()
+    if attr in ("begin", "begin_ranged") and (
+            STOREISH_RE.search(recv_src) or "store" in res_l):
+        return _WRITER
+    if attr == "lease" and (FLIGHTISH_RE.search(recv_src)
+                            or "flight" in res_l):
+        return _FLIGHT
+    if attr in ("acquire", "charge") and (
+            BUDGETISH_RE.search(recv_src) or "budget" in res_l):
+        return _BUDGET
+    if attr in _HTTP_VERBS:
+        for kw in call.keywords:
+            if kw.arg == "stream" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return _RESPONSE
+    if attr in ("span", "start_span") and (
+            TRACEISH_RE.search(recv_src) or "trace" in res_l):
+        return _SPAN
+    return None
+
+
+@dataclass
+class ObligationSite:
+    """One acquire and everything local analysis learned about it."""
+
+    kind: str
+    label: str
+    line: int
+    acquire_src: str          # short source of the acquire expr
+    entity: str               # bound name / receiver dotted text
+    carrier: str
+    #: ("discharge", line) | ("transfer", how, line) | None — the first
+    #: normal-path settle point in source order
+    settle: tuple | None = None
+    #: resolved-callee handoffs seen before any definite settle:
+    #: [(callee qname, callee param name, line)] — composed by the pass
+    forwards: list = field(default_factory=list)
+    #: unprotected may-raise statements inside the live region:
+    #: [(line, src)] — each is a path where the entity leaks
+    risky: list = field(default_factory=list)
+    #: result-carried acquire whose value is thrown away on the spot
+    discarded: bool = False
+    #: leadership variable of a ``flight, leader = lease(...)`` unpack:
+    #: statements guarded on it are follower paths — the lease is the
+    #: LEADER's obligation, so those raises are not this site's leaks
+    guard: str = ""
+
+
+# --------------------------------------------------------------- events
+
+
+def _recv_of(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute):
+        return dotted(call.func.value) or ""
+    return ""
+
+
+def _names_in_value(expr: ast.AST) -> Iterator[str]:
+    """Names DIRECTLY carried by an expression (ownership moves with
+    the value): a bare name, or names inside a tuple/list literal.
+    ``v.digest()`` carries v's result, not v — deliberately excluded."""
+    if isinstance(expr, ast.Name):
+        yield expr.id
+    elif isinstance(expr, (ast.Tuple, ast.List)):
+        for e in expr.elts:
+            if isinstance(e, ast.Name):
+                yield e.id
+
+
+def _param_of(info: "FunctionInfo", call: ast.Call,
+              arg_node: ast.AST) -> str | None:
+    """Which parameter of ``info`` this positional/keyword arg fills."""
+    params = list(info.params)
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    for i, a in enumerate(call.args):
+        if a is arg_node:
+            if any(isinstance(x, ast.Starred) for x in call.args[:i + 1]):
+                return None
+            return params[i] if i < len(params) else None
+    for kw in call.keywords:
+        if kw.value is arg_node:
+            return kw.arg
+    return None
+
+
+class _FnScan:
+    """One walk over a function body, shared by every entity analyzed
+    in it: per-name ownership events, may-raise statements, and the
+    try-structure needed for the protected-region check."""
+
+    def __init__(self, ctx: "ModuleContext", fn: ast.AST,
+                 index: "ProjectIndex") -> None:
+        self.ctx = ctx
+        self.fn = fn
+        self.index = index
+        #: name → [(line, kind, payload, node)] — kind in {"discharge",
+        #: "transfer", "forward", "end"}; payload: method name /
+        #: how / (callee q, param); node anchors the branch-arm check
+        self.events: dict[str, list] = {}
+        #: may-raise statements: [(line, node)]
+        self.risky: list = []
+        self._res = index.resolution.get(ctx.rel, {})
+        self._walk()
+
+    def _add(self, name: str, line: int, kind: str, payload=None,
+             node: ast.AST | None = None) -> None:
+        self.events.setdefault(name, []).append((line, kind, payload, node))
+
+    def _is_safe_call(self, call: ast.Call) -> bool:
+        name = dotted(call.func) or ""
+        return name in _SAFE_EXACT or name.startswith(_SAFE_PREFIXES)
+
+    def _note_call(self, call: ast.Call) -> None:
+        name = dotted(call.func) or ""
+        recv = _recv_of(call)
+        attr = call.func.attr if isinstance(call.func, ast.Attribute) \
+            else ""
+        # discharge: release-method on a dotted receiver
+        if recv and attr in ANY_RELEASE:
+            self._add(recv, call.lineno, "discharge", attr, call)
+            # a release on self.<a>.<b> also discharges entity self.<a>?
+            # no — keep identity exact (no speculative discharges)
+        if name == "os.close" and call.args:
+            tgt = dotted(call.args[0])
+            if tgt:
+                self._add(tgt, call.lineno, "discharge", "os.close", call)
+        # entity handed off as an argument
+        q = self._res.get(id(call))
+        callee = self.index.functions.get(q) if q else None
+        ctor = None
+        if callee is None and name:
+            ctor = self.index.resolve_class(self.ctx, name)
+        for arg in list(call.args) + [k.value for k in call.keywords]:
+            seed = arg.value if isinstance(arg, ast.Starred) else arg
+            if not isinstance(seed, ast.Name):
+                continue
+            v = seed.id
+            if name in OWNER_TAKING:
+                self._add(v, call.lineno, "transfer", f"{name}()", call)
+            elif attr in CONTAINER_SINKS:
+                self._add(v, call.lineno, "transfer",
+                          f"stored via .{attr}()", call)
+            elif ctor is not None:
+                self._add(v, call.lineno, "transfer",
+                          f"owned by {ctor.rsplit('.', 1)[-1]}(...)", call)
+            elif callee is not None:
+                p = _param_of(callee, call, arg)
+                if p is not None:
+                    self._add(v, call.lineno, "forward", (q, p), call)
+
+    def _walk(self) -> None:
+        for node in walk_in_scope(self.fn):
+            if isinstance(node, ast.Call):
+                self._note_call(node)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call) and (
+                            dotted(expr.func) or "") in OWNER_TAKING \
+                            and expr.args:
+                        expr = expr.args[0]
+                    tgt = dotted(expr)
+                    if tgt:
+                        self._add(tgt, node.lineno, "discharge", "with",
+                                  node)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                for v in _names_in_value(node.value):
+                    self._add(v, node.lineno, "transfer", "returned", node)
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)) \
+                    and getattr(node, "value", None) is not None:
+                for v in _names_in_value(node.value):
+                    self._add(v, node.lineno, "transfer", "yielded", node)
+            elif isinstance(node, ast.Assign):
+                for v in _names_in_value(node.value):
+                    self._add(v, node.lineno, "transfer",
+                              self._store_how(node), node)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._add(tgt.id, node.lineno, "end", None, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                # a nested def capturing the entity owns it now
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name):
+                        self._add(sub.id, node.lineno, "transfer",
+                                  "captured by a nested def", node)
+            # ---- risk collection (leaf statements + branch tests)
+            if isinstance(node, (ast.Expr, ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign, ast.Return)):
+                if any(isinstance(c, ast.Call) and not self._is_safe_call(c)
+                       for c in ast.walk(node)):
+                    self.risky.append((node.lineno, node))
+            elif isinstance(node, (ast.Raise, ast.Assert)):
+                self.risky.append((node.lineno, node))
+            elif isinstance(node, (ast.If, ast.While)):
+                if any(isinstance(c, ast.Call) and not self._is_safe_call(c)
+                       for c in ast.walk(node.test)):
+                    self.risky.append((node.lineno, node))
+            elif isinstance(node, ast.For):
+                if any(isinstance(c, ast.Call) and not self._is_safe_call(c)
+                       for c in ast.walk(node.iter)):
+                    self.risky.append((node.lineno, node))
+        for evs in self.events.values():
+            evs.sort(key=lambda e: e[0])
+        self.risky.sort(key=lambda r: r[0])
+
+    @staticmethod
+    def _store_how(node: ast.Assign) -> str:
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Attribute):
+            return f"stored to {dotted(tgt) or 'an attribute'}"
+        if isinstance(tgt, ast.Subscript):
+            return "stored into a container"
+        return "aliased"
+
+    # ------------------------------------------------------ branch arms
+    def _arms(self, node: ast.AST) -> dict:
+        """id(If) → which arm (``body``/``orelse``) this node sits in,
+        for every enclosing If up to the function."""
+        arms: dict[int, str] = {}
+        child = node
+        cur = getattr(node, "_dm_parent", None)
+        while cur is not None and child is not self.fn:
+            if isinstance(cur, ast.If):
+                if child in cur.body:
+                    arms[id(cur)] = "body"
+                elif child in cur.orelse:
+                    arms[id(cur)] = "orelse"
+            child = cur
+            cur = getattr(cur, "_dm_parent", None)
+        return arms
+
+    def _exclusive(self, a: ast.AST, b: ast.AST | None) -> bool:
+        """True when a and b sit in SIBLING arms of one If — a settle
+        on the other arm of the acquire's branch never executes on the
+        acquire's path and must not count."""
+        if b is None:
+            return False
+        aa = self._arms(a)
+        if not aa:
+            return False
+        bb = self._arms(b)
+        return any(k in bb and bb[k] != v for k, v in aa.items())
+
+    def _guarded_on(self, node: ast.AST, name: str) -> bool:
+        """Is node under an If whose test reads ``name``?"""
+        cur = getattr(node, "_dm_parent", None)
+        while cur is not None and cur is not self.fn:
+            if isinstance(cur, ast.If) and any(
+                    isinstance(n, ast.Name) and n.id == name
+                    for n in ast.walk(cur.test)):
+                return True
+            cur = getattr(cur, "_dm_parent", None)
+        return False
+
+    # ------------------------------------------------------ protection
+    def _try_discharges(self, try_node: ast.Try, entity: str,
+                        releases: frozenset) -> bool:
+        """Does this try's finally/except discharge ``entity``?"""
+        bodies = list(try_node.finalbody)
+        for h in try_node.handlers:
+            bodies.extend(h.body)
+        for stmt in bodies:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in releases \
+                        and (dotted(sub.func.value) or "") == entity:
+                    return True
+                if (dotted(sub.func) or "") == "os.close" and sub.args \
+                        and (dotted(sub.args[0]) or "") == entity:
+                    return True
+        return False
+
+    def _protected(self, node: ast.AST, entity: str,
+                   releases: frozenset) -> bool:
+        """Is an exception AT ``node`` guaranteed to discharge the
+        entity (an enclosing try releases it in finally/except)? A
+        statement inside an except handler is already a cleanup path —
+        never flagged."""
+        child = node
+        cur = getattr(node, "_dm_parent", None)
+        while cur is not None and cur is not self.fn:
+            if isinstance(cur, ast.ExceptHandler):
+                return True  # cleanup path, out of scope
+            if isinstance(cur, ast.Try) and child in cur.body \
+                    and self._try_discharges(cur, entity, releases):
+                return True
+            child = cur
+            cur = getattr(cur, "_dm_parent", None)
+        return False
+
+    # -------------------------------------------------------- analysis
+    def analyze(self, site: ObligationSite, releases: frozenset,
+                acquire: ast.AST) -> None:
+        """Fill ``site.settle``/``forwards``/``risky`` from the raw
+        event stream: first settle in source order, skipping events in
+        a branch arm the acquire's path can never reach."""
+        evs = [e for e in self.events.get(site.entity, [])
+               if e[0] > site.line or (e[0] == site.line and e[1] != "end")]
+        settle = None
+        for line, kind, payload, node in evs:
+            if self._exclusive(acquire, node):
+                continue
+            if kind == "discharge" and (payload in releases
+                                        or payload in ("with", "os.close")):
+                settle = ("discharge", line)
+                break
+            if kind == "transfer":
+                settle = ("transfer", payload, line)
+                break
+            if kind == "end":
+                # rebound before any settle: a new epoch starts; stay
+                # silent (under-approximation — no speculative leaks)
+                settle = ("transfer", "rebound", line)
+                break
+            if kind == "forward":
+                site.forwards.append((payload[0], payload[1], line))
+        site.settle = settle
+        end_line = settle[-1] if settle is not None else (
+            site.forwards[0][2] if site.forwards else None)
+        if end_line is None:
+            return
+        for line, node in self.risky:
+            if not (site.line < line < end_line):
+                continue
+            if self._protected(node, site.entity, releases):
+                continue
+            if self._exclusive(acquire, node):
+                continue
+            if site.guard and self._guarded_on(node, site.guard):
+                continue  # follower path of a leased flight
+            src = self.ctx.lines[line - 1].strip() if \
+                line <= len(self.ctx.lines) else ""
+            site.risky.append((line, src[:60]))
+
+
+# ------------------------------------------------------------ collection
+
+
+def collect(ctx: "ModuleContext", fn: ast.AST, info: "FunctionInfo",
+            index: "ProjectIndex") -> None:
+    """Fill ``info.obligations`` / ``info.param_fate`` /
+    ``info.released_receivers`` — the per-function summary facts."""
+    res_map = index.resolution.get(ctx.rel, {})
+    scan = _FnScan(ctx, fn, index)
+
+    for node in walk_in_scope(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        recv = _recv_of(node)
+        res = classify_acquire(node, recv, res_map.get(id(node)))
+        if res is None:
+            continue
+        parent = getattr(node, "_dm_parent", None)
+        # syntactic position decides the entity (or settles on the spot)
+        if isinstance(parent, (ast.withitem,)):
+            continue  # with acquire() as v: discharged by construction
+        if isinstance(parent, ast.Call) and (
+                dotted(parent.func) or "") in OWNER_TAKING:
+            continue  # closing(acquire(...)): ownership moved
+        site = ObligationSite(
+            kind=res.kind, label=res.label, line=node.lineno,
+            acquire_src=ctx.src(node)[:80], entity="", carrier=res.carrier)
+        if res.carrier == "receiver":
+            # no local settle here means the class/project discipline
+            # decides (the pass's global released-receivers check)
+            site.entity = recv
+            scan.analyze(site, res.releases, node)
+            info.obligations.append(site)
+            continue
+        # result-carried: how is the value bound?
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            tgt = parent.targets[0]
+            if isinstance(tgt, ast.Name):
+                site.entity = tgt.id
+            elif isinstance(tgt, ast.Tuple) and res.kind == "flight" \
+                    and tgt.elts and isinstance(tgt.elts[0], ast.Name):
+                site.entity = tgt.elts[0].id  # (flight, is_leader) unpack
+                if len(tgt.elts) > 1 and isinstance(tgt.elts[1], ast.Name):
+                    site.guard = tgt.elts[1].id
+            elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                continue  # self.x = acquire(): stored, owner inherits
+            else:
+                continue
+        elif isinstance(parent, ast.Return):
+            continue  # returned directly: the caller inherits
+        elif isinstance(parent, ast.Expr):
+            site.discarded = True
+            info.obligations.append(site)
+            continue
+        else:
+            continue  # argument / comprehension / etc: out of scope
+        scan.analyze(site, res.releases, node)
+        info.obligations.append(site)
+
+    # releases-obligation facts: receivers this function discharges
+    # (the global discipline check for receiver-carried tickets)
+    for name, evs in scan.events.items():
+        if any(kind == "discharge" and payload not in ("with",)
+               for _, kind, payload, _n in evs):
+            info.released_receivers.add(name)
+    # parameter fates: how this function treats an obligation handed to
+    # it — collected for EVERY function so a caller's transfer can be
+    # judged (a callee that provably drops the entity is the leak the
+    # interprocedural contract pins back on the acquire site). A
+    # definite event (release/keep/rebind) ANYWHERE outranks a soft
+    # forward: `helper(v); v.close()` releases, whatever helper does.
+    for p in info.params:
+        if p in ("self", "cls"):
+            continue
+        fate = None
+        fwd = None
+        for line, kind, payload, _n in scan.events.get(p, []):
+            if kind == "discharge":
+                fate = ("released", line)
+            elif kind == "transfer":
+                fate = ("kept", payload, line)
+            elif kind == "end":
+                fate = ("kept", "rebound", line)
+            elif fwd is None:
+                fwd = ("forwarded", payload[0], payload[1], line)
+            if fate is not None:
+                break
+        info.param_fate[p] = fate or fwd or ("dropped",)

@@ -14,6 +14,7 @@ from tools.analyze.passes import (  # noqa: F401 — registration imports
     lock_order,
     log_hygiene,
     metric_hygiene,
+    obligation_leak,
     surface_parity,
     swarm_policy,
     threads,

@@ -1,0 +1,280 @@
+"""obligation-leak: paired resources must be released on every path.
+
+The Infer/Pulse must-call shape over the ProjectIndex's obligation
+facts (:mod:`tools.analyze.obligations`): every acquire of a tracked
+resource — budget tickets, flight leases, store partial writers, fds,
+mmaps, streamed HTTP responses, spans — must reach a release, or its
+ownership must provably move (returned, stored, handed to a callee
+that releases or keeps it). Four finding shapes, all blamed at the
+acquire site Infer-style:
+
+- **discarded** — the acquire's result is thrown away on the spot;
+  nothing can ever release it.
+- **never settled** — no release, return, store, or handoff on any
+  path out of the function.
+- **dropped by callee** — the entity's only escapes are calls to
+  resolved project functions, and composing ``transfers-ownership``
+  facts through the call graph (bounded depth, same contract as the
+  budget summary) shows every one of them drops the parameter: the
+  handoff is an illusion and the blame lands back on the acquire.
+- **leaks on raise** — the normal path settles, but a may-raise
+  statement sits between the acquire and the settle point outside any
+  ``try`` whose ``finally``/handler releases the entity.
+
+Receiver-carried budget tickets get the global-discipline variant: an
+``acquire``/``charge`` with no local release is fine as long as
+SOMETHING in the project releases that receiver (the split
+acquire-here-release-there pattern is the design); zero releases
+anywhere is the unpaired-obligation finding.
+
+Twin on the native plane: the same rule runs the
+:mod:`tools.analyze.native_index` extractor over ``native/*.{h,cc}``
+(``mmap/munmap``, fd ``open/close``, ``SSL_new/SSL_free``,
+``hot_acquire/hot_release``, epoll registrations), RAII-aware.
+Anchoring mirrors surface-parity: the real tree activates via
+``demodel_tpu/utils/env.py`` → ``<root>/native``; fixtures via a
+``# demodel: obligation-native=<dir>`` pragma.
+
+Everything unresolved stays silent — no speculative leaks.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Iterator
+
+from tools.analyze.core import Finding, ModuleContext, Pass, register
+from tools.analyze import native_index
+
+_PRAGMA_RE = re.compile(r"#\s*demodel:\s*obligation-native=(\S+)")
+
+#: interprocedural composition depth for transfers-ownership facts —
+#: matches the budget summary's contract (deep chains go "unknown",
+#: and unknown is silent)
+_MAX_DEPTH = 4
+
+
+@register
+class ObligationLeakPass(Pass):
+    id = "obligation-leak"
+    version = "1"
+    description = (
+        "paired-resource lifecycle: budget tickets, flight leases, store "
+        "partial writers, fds/mmaps, streamed responses and spans must be "
+        "released on every path — discarded acquires, never-settled "
+        "entities, handoffs to callees that provably drop them, and "
+        "raise-paths that skip the release; native twin over "
+        "mmap/munmap, open/close, SSL_new/SSL_free, hot pins and epoll "
+        "registrations, RAII-aware"
+    )
+
+    @classmethod
+    def cache_extra_inputs(cls, files) -> list:
+        """The native sources this rule scans: their stat triples join
+        the cache key so a ``native/*.{h,cc}`` edit alone invalidates
+        cached findings (same contract as surface-parity)."""
+        dirs: list[Path] = []
+        for p in files:
+            path = Path(p)
+            posix = path.as_posix()
+            if posix.endswith("demodel_tpu/utils/env.py"):
+                root = Path(posix[: -len("demodel_tpu/utils/env.py")]
+                            or ".")
+                dirs.append(root / "native")
+                continue
+            try:
+                head = path.read_text(encoding="utf-8",
+                                      errors="replace")[:4096]
+            except OSError:
+                continue
+            pm = _PRAGMA_RE.search(head)
+            if pm:
+                dirs.append(path.parent / pm.group(1))
+        out: list[Path] = []
+        for d in dirs:
+            if d.is_dir():
+                out.extend(sorted(d.glob("*.h")))
+                out.extend(sorted(d.glob("*.cc")))
+        return out
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._native_dirs: list[tuple[Path, str]] = []
+
+    # ------------------------------------------------------------ visit
+    def visit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        pm = _PRAGMA_RE.search(ctx.source)
+        if pm:
+            self._native_dirs.append(
+                (Path(ctx.path).resolve().parent / pm.group(1),
+                 ctx.rel.rsplit("/", 1)[0] + "/" + pm.group(1) + "/"
+                 if "/" in ctx.rel else pm.group(1) + "/"))
+        elif ctx.rel == "demodel_tpu/utils/env.py":
+            root = Path(str(Path(ctx.path).resolve())[: -len(ctx.rel)]) \
+                if str(Path(ctx.path).resolve()).endswith(ctx.rel) \
+                else Path.cwd()
+            self._native_dirs.append((root / "native", "native/"))
+        return iter(())
+
+    # --------------------------------------------------------- finalize
+    def finalize(self) -> Iterator[Finding]:
+        yield from self._python_plane()
+        seen: set[Path] = set()
+        for native_dir, prefix in self._native_dirs:
+            if native_dir in seen or not native_dir.is_dir():
+                continue
+            seen.add(native_dir)
+            yield from self._native_plane(native_dir, prefix)
+
+    # ------------------------------------------------- the Python plane
+    def _python_plane(self) -> Iterator[Finding]:
+        released_global = self._released_receivers_by_class()
+        for qname in sorted(self.index.functions):
+            info = self.index.functions[qname]
+            for site in info.obligations:
+                yield from self._judge(qname, info, site, released_global)
+
+    def _released_receivers_by_class(self) -> dict:
+        """cls qname (or "" for free functions) → receiver texts some
+        method releases — the global side of the receiver-carried
+        discipline."""
+        out: dict[str, set[str]] = {}
+        for info in self.index.functions.values():
+            key = info.cls or ""
+            out.setdefault(key, set()).update(info.released_receivers)
+        return out
+
+    def _judge(self, qname, info, site, released_global) -> Iterator[Finding]:
+        short = qname.rsplit(".", 1)[-1]
+        if site.discarded:
+            yield Finding(
+                info.rel, site.line, self.id,
+                f"{site.label} acquired by `{site.acquire_src}` and the "
+                f"result is discarded — nothing can ever release it; "
+                "bind it and release in a finally, or use `with`",
+            )
+            return
+        if site.carrier == "receiver":
+            yield from self._judge_receiver(info, site, released_global,
+                                            short)
+            return
+        settle = site.settle
+        if settle is None and not site.forwards:
+            yield Finding(
+                info.rel, site.line, self.id,
+                f"{site.label} bound to `{site.entity}` here is never "
+                f"released, returned, or stored on any path out of "
+                f"{short}() — leaked unconditionally",
+            )
+            return
+        if settle is None:
+            # every escape is a resolved-callee handoff: compose the
+            # callees' transfers-ownership facts
+            fates = [self._fate(q, param, 0, set())
+                     for q, param, _line in site.forwards]
+            if fates and all(f == "dropped" for f in fates):
+                q, param, line = site.forwards[0]
+                callee = q.rsplit(".", 1)[-1]
+                yield Finding(
+                    info.rel, site.line, self.id,
+                    f"{site.label} bound to `{site.entity}` here is "
+                    f"handed to {callee}() (line {line}) which neither "
+                    f"releases nor keeps parameter `{param}` — the "
+                    "obligation is dropped in the callee; release it "
+                    f"here or make {callee}() take ownership",
+                )
+            return
+        if settle[0] == "transfer" and settle[1] == "rebound":
+            return  # rebinding starts a new epoch: silent by contract
+        yield from self._risky(info, site, short)
+
+    def _judge_receiver(self, info, site, released_global,
+                        short) -> Iterator[Finding]:
+        settle = site.settle
+        if settle is not None and settle[0] == "discharge":
+            # acquire and release in one body: the path between them
+            # must be protected (the PR-3 leaked-ticket shape)
+            yield from self._risky(info, site, short)
+            return
+        if settle is not None:
+            return  # receiver transferred/rebound: out of scope
+        recv = site.entity
+        tail = recv.rsplit(".", 1)[-1]
+        pools = [released_global.get(info.cls or "", set())] \
+            if info.cls else []
+        pools.append({r for s in released_global.values() for r in s})
+        for pool in pools:
+            if recv in pool or any(r.rsplit(".", 1)[-1] == tail
+                                   for r in pool):
+                return  # something in the project releases this receiver
+        yield Finding(
+            info.rel, site.line, self.id,
+            f"{site.label} charged on `{recv}` in {short}() but nothing "
+            f"in the project ever releases `{recv}` — an unpaired "
+            "obligation; every acquire/charge needs a release/abort "
+            "somewhere",
+        )
+
+    def _risky(self, info, site, short) -> Iterator[Finding]:
+        if not site.risky:
+            return
+        line, src = site.risky[0]
+        settle = site.settle
+        how = f"the release at line {settle[1]}" if settle[0] == \
+            "discharge" else f"the handoff at line {settle[-1]}"
+        more = f" (+{len(site.risky) - 1} more such lines)" \
+            if len(site.risky) > 1 else ""
+        yield Finding(
+            info.rel, site.line, self.id,
+            f"{site.label} bound to `{site.entity}` here leaks if "
+            f"`{src}` (line {line}){more} raises before {how} — wrap "
+            "the risky region in try/finally or release in an except",
+        )
+
+    def _fate(self, q, param, depth, seen) -> str:
+        """What a callee does with an obligation handed to ``param`` —
+        "settled" (released or kept), "dropped", or "unknown" (silent).
+        Follows forwarded params through the call graph to _MAX_DEPTH,
+        the same bounded composition the budget summary uses."""
+        if depth > _MAX_DEPTH or (q, param) in seen:
+            return "unknown"
+        seen.add((q, param))
+        info = self.index.functions.get(q)
+        if info is None:
+            return "unknown"
+        fate = info.param_fate.get(param)
+        if fate is None:
+            return "unknown"
+        if fate[0] == "forwarded":
+            return self._fate(fate[1], fate[2], depth + 1, seen)
+        if fate[0] == "dropped":
+            return "dropped"
+        return "settled"
+
+    # ------------------------------------------------- the native plane
+    def _native_plane(self, native_dir: Path,
+                      prefix: str) -> Iterator[Finding]:
+        for path in sorted(native_dir.glob("*.h")) + sorted(
+                native_dir.glob("*.cc")):
+            rel = f"{prefix}{path.name}"
+            for fn in native_index.extract_functions(path, rel):
+                for ob in native_index.scan_function(fn):
+                    if ob.never_settled:
+                        yield Finding(
+                            ob.rel, ob.line, self.id,
+                            f"{ob.label} `{ob.entity}` acquired in "
+                            f"{ob.fn_name}() is never released, stored, "
+                            "returned, or handed off — leaked "
+                            "unconditionally",
+                        )
+                    elif ob.leak_exit is not None:
+                        eline, esrc = ob.leak_exit
+                        yield Finding(
+                            ob.rel, ob.line, self.id,
+                            f"{ob.label} `{ob.entity}` acquired in "
+                            f"{ob.fn_name}() leaks at the early exit "
+                            f"`{esrc}` (line {eline}) before the "
+                            "release — release on the error path or "
+                            "adopt it with a scope guard",
+                        )

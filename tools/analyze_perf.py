@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""CI analyze-perf leg: profile a cold full-tree analyze, gate the warm one.
+
+Two contracts, one script (the CI ``analyze-perf`` step runs it):
+
+- the COLD run executes in-process under the repo's own sampling
+  profiler (``demodel_tpu.utils.profiler``, the PR 13 plane) and writes
+  a collapsed flame (``analyze_cold.folded``, the flamegraph.pl /
+  speedscope interchange) uploaded as a build artifact — an analyzer
+  slowdown is diagnosable from the CI page without reproducing locally;
+- the WARM run (result cache hot) goes through the real CLI twice —
+  prime, then measure — and must report ``cache: hit`` with ``secs:``
+  under the budget (default 0.5s, ``DEMODEL_ANALYZE_WARM_BUDGET``
+  overrides). The same bound is a tier-1 test
+  (``test_warm_cache_is_subsecond``); this leg catches the regression
+  on the PR that introduces it even when the test suite is skipped.
+
+Usage: ``python tools/analyze_perf.py [paths...]`` (default demodel_tpu).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def main(argv: list[str] | None = None) -> int:
+    sys.path.insert(0, str(REPO))
+    os.chdir(REPO)
+    from demodel_tpu.utils.profiler import Profiler, collapse
+    from tools.analyze.__main__ import main as analyze_main
+
+    paths = list(argv if argv is not None else sys.argv[1:]) or ["demodel_tpu"]
+
+    # cold leg: private Profiler instance (no DEMODEL_OBS gating, no
+    # singleton) sampling the analyzing thread at a rate high enough to
+    # resolve per-pass frames on a runs-in-seconds workload
+    prof = Profiler(hz=250, max_stacks=4096)
+    prof.start()
+    try:
+        rc_cold = analyze_main(["--no-cache", "--stats", *paths])
+    finally:
+        prof.stop()
+    snap = prof.snapshot()
+    record = {"stacks": [
+        {"stack": k, "wall": v[0], "cpu": v[1]} for k, v in snap.items()]}
+    flame = REPO / "analyze_cold.folded"
+    flame.write_text(collapse(record))
+    print(f"cold analyze rc={rc_cold}; "
+          f"{sum(v[0] for v in snap.values())} wall samples -> {flame}",
+          file=sys.stderr)
+
+    # warm leg: prime, then measure through the real CLI so the gate
+    # covers key computation + cache load, not just the passes
+    budget = float(os.environ.get("DEMODEL_ANALYZE_WARM_BUDGET", "0.5"))
+    cmd = [sys.executable, "-m", "tools.analyze", "--stats", *paths]
+    subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+    warm = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+    m = re.search(r"secs: ([0-9.]+)", warm.stderr)
+    if not m or "cache: hit" not in warm.stderr:
+        print("warm leg did not report a cache hit:\n" + warm.stderr,
+              file=sys.stderr)
+        return 1
+    secs = float(m.group(1))
+    print(f"warm analyze: {secs:.3f}s (budget {budget}s, cache hit)",
+          file=sys.stderr)
+    if secs >= budget:
+        print(f"::error::warm analyze took {secs:.3f}s >= {budget}s — "
+              "the result cache regressed", file=sys.stderr)
+        return 1
+    return rc_cold
+
+
+if __name__ == "__main__":
+    sys.exit(main())
