@@ -36,6 +36,8 @@ NATIVE_LOCK_RANKS = {
     "kRankProxyRestore": 20,
     "kRankProxyTelemetry": 22,
     "kRankProxyProfile": 24,
+    "kRankProxyKtls": 26,
+    "kRankProxyFdCache": 27,
     "kRankStoreGc": 30,
     "kRankStoreWriters": 32,
     "kRankStoreIndex": 34,

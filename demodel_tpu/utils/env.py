@@ -175,3 +175,25 @@ def profile_window_s() -> int:
     into the telemetry archive (Python plane only — the native sampler
     exports cumulative aggregates and the restore server windows them)."""
     return env_int("DEMODEL_PROFILE_WINDOW_S", 60, minimum=5)
+
+
+def proxy_write_timeout() -> int:
+    """``DEMODEL_PROXY_WRITE_TIMEOUT``: per-connection deadline (seconds)
+    for the reactor's EPOLLOUT writer to fully drain one response; a
+    client still holding an undrained body past it is evicted."""
+    return env_int("DEMODEL_PROXY_WRITE_TIMEOUT", 75, minimum=1)
+
+
+def proxy_write_min_bps() -> int:
+    """``DEMODEL_PROXY_WRITE_MIN_BPS``: low-watermark drain rate for the
+    writer stall sweep — a connection draining slower than this (checked
+    about once a second) is evicted early. 0 (the default) disables the
+    watermark; only the write deadline then bounds a slow reader."""
+    return env_int("DEMODEL_PROXY_WRITE_MIN_BPS", 0, minimum=0)
+
+
+def proxy_ktls() -> bool:
+    """``DEMODEL_PROXY_KTLS``: allow kernel-TLS ``SSL_sendfile`` for
+    MITM'd cache hits (on by default; availability is runtime-probed and
+    the chunked ``SSL_write`` pump is the automatic fallback)."""
+    return env_bool("DEMODEL_PROXY_KTLS", True)

@@ -224,10 +224,12 @@ def parse_labels(name: str) -> tuple[str, dict[str, str]]:
 
 
 #: native proxy metrics that are point-in-time pool state, not monotonic
-#: counters — the session executor's live occupancy, queue depth, and the
-#: reactor's parked keep-alive connections
+#: counters — the session executor's live occupancy, queue depth, the
+#: reactor's parked keep-alive connections, and the writer plane's
+#: in-flight EPOLLOUT drains / spliced CONNECT tunnels
 PROXY_GAUGES = frozenset({"sessions_active", "sessions_queue_depth",
-                          "sessions_parked"})
+                          "sessions_parked", "conns_writing",
+                          "tunnels_spliced"})
 
 
 # ------------------------------------------------------- telemetry plane

@@ -12,7 +12,7 @@
 //
 // Rank order (low = outermost, must be acquired first):
 //   Proxy:  reactor < queue < sessions < fill < leaf < upstream < hint
-//           < restore
+//           < restore < telemetry < profile < ktls
 //   Store:  gc < writers < index < pin < fd < hot
 // Proxy locks rank below Store locks because proxy paths call into the
 // store while holding their own locks (register_tensor holds restore_mu_
@@ -44,6 +44,8 @@ constexpr int kRankProxyHint = 18;
 constexpr int kRankProxyRestore = 20;
 constexpr int kRankProxyTelemetry = 22;  // leaf: held only over ring ops
 constexpr int kRankProxyProfile = 24;  // leaf: profiler aggregate only
+constexpr int kRankProxyKtls = 26;  // leaf: one-shot kTLS probe cache only
+constexpr int kRankProxyFdCache = 27;  // leaf: shared store read-fd refcounts
 constexpr int kRankStoreGc = 30;
 constexpr int kRankStoreWriters = 32;
 constexpr int kRankStoreIndex = 34;

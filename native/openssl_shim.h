@@ -25,9 +25,20 @@ typedef struct dm_x509_vfy_param_st X509_VERIFY_PARAM;
 // live in headers we don't have)
 #define DM_SSL_FILETYPE_PEM 1
 #define DM_SSL_VERIFY_PEER 0x01
+#define DM_SSL_ERROR_WANT_READ 2
+#define DM_SSL_ERROR_WANT_WRITE 3
 #define DM_SSL_ERROR_ZERO_RETURN 6
 #define DM_SSL_CTRL_SET_TLSEXT_HOSTNAME 55
 #define DM_TLSEXT_NAMETYPE_host_name 0
+// kTLS surface (OpenSSL 3.x ABI values): SSL_OP_ENABLE_KTLS is
+// SSL_OP_BIT(3); the BIO ctrl asks whether the write BIO actually
+// offloaded to the kernel after the handshake; SSL_CTRL_MODE arms
+// partial/moving-buffer writes for the non-blocking SSL_write pump.
+#define DM_SSL_OP_ENABLE_KTLS 0x8ul
+#define DM_BIO_CTRL_GET_KTLS_SEND 73
+#define DM_SSL_CTRL_MODE 33
+#define DM_SSL_MODE_ENABLE_PARTIAL_WRITE 0x1l
+#define DM_SSL_MODE_ACCEPT_MOVING_WRITE_BUFFER 0x2l
 
 namespace dm_ssl {
 
@@ -60,6 +71,15 @@ struct Api {
   unsigned long (*ERR_get_error_)(void);
   void (*ERR_error_string_n_)(unsigned long, char *, size_t);
   void (*ERR_clear_error_)(void);
+  // OPTIONAL kTLS surface — bound with plain dlsym (never need(), which
+  // aborts): SSL_sendfile exists only in OpenSSL 3.0+, and a 1.1 runtime
+  // must still serve (callers null-check and fall back to the SSL_write
+  // pump). BIO* is opaque void* here — only ever passed straight back
+  // into BIO_ctrl.
+  unsigned long (*SSL_set_options_)(SSL *, unsigned long);
+  void *(*SSL_get_wbio_)(const SSL *);
+  long (*BIO_ctrl_)(void *, int, long, void *);
+  long (*SSL_sendfile_)(SSL *, int, long, size_t, int);
 };
 
 inline Api &api() {
@@ -125,6 +145,16 @@ inline Api &api() {
     DM_BIND(crypto, ERR_error_string_n_, "ERR_error_string_n");
     DM_BIND(crypto, ERR_clear_error_, "ERR_clear_error");
 #undef DM_BIND
+    // nullable binds (see Api): absent symbols leave null pointers and
+    // the writer plane degrades to the userspace SSL_write pump
+    x.SSL_set_options_ = reinterpret_cast<decltype(x.SSL_set_options_)>(
+        ::dlsym(ssl, "SSL_set_options"));
+    x.SSL_get_wbio_ = reinterpret_cast<decltype(x.SSL_get_wbio_)>(
+        ::dlsym(ssl, "SSL_get_wbio"));
+    x.SSL_sendfile_ = reinterpret_cast<decltype(x.SSL_sendfile_)>(
+        ::dlsym(ssl, "SSL_sendfile"));
+    x.BIO_ctrl_ = reinterpret_cast<decltype(x.BIO_ctrl_)>(
+        ::dlsym(crypto, "BIO_ctrl"));
     return x;
   }();
   return a;

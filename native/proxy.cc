@@ -26,6 +26,7 @@
 #include <cstring>
 #include <ctime>
 #include <sstream>
+#include <unordered_set>
 
 #include "openssl_shim.h"
 #include "sha256.h"
@@ -414,7 +415,7 @@ std::string Metrics::hist_json() const {
 }
 
 std::string Metrics::json() const {
-  char buf[1024];
+  char buf[1536];
   ::snprintf(buf, sizeof buf,
              "{\"connects\":%llu,\"mitm\":%llu,\"tunnel\":%llu,\"requests\":%llu,"
              "\"cache_hits\":%llu,\"cache_misses\":%llu,\"bytes_up\":%llu,"
@@ -422,7 +423,10 @@ std::string Metrics::json() const {
              "\"sessions_active\":%llu,\"sessions_queue_depth\":%llu,"
              "\"sessions_rejected_total\":%llu,\"serve_bytes_total\":%llu,"
              "\"sessions_idle_closed_total\":%llu,\"sessions_parked\":%llu,"
-             "\"reactor_wakeups_total\":%llu}",
+             "\"reactor_wakeups_total\":%llu,"
+             "\"conns_writing\":%llu,\"tunnels_spliced\":%llu,"
+             "\"write_stall_evictions_total\":%llu,\"sendfile_bytes_total\":%llu,"
+             "\"ktls_sends_total\":%llu,\"splice_bytes_total\":%llu}",
              (unsigned long long)connects.load(), (unsigned long long)mitm.load(),
              (unsigned long long)tunnel.load(), (unsigned long long)requests.load(),
              (unsigned long long)cache_hits.load(), (unsigned long long)cache_misses.load(),
@@ -434,7 +438,13 @@ std::string Metrics::json() const {
              (unsigned long long)serve_bytes.load(),
              (unsigned long long)sessions_idle_closed.load(),
              (unsigned long long)sessions_parked.load(),
-             (unsigned long long)reactor_wakeups.load());
+             (unsigned long long)reactor_wakeups.load(),
+             (unsigned long long)conns_writing.load(),
+             (unsigned long long)tunnels_spliced.load(),
+             (unsigned long long)write_stall_evictions.load(),
+             (unsigned long long)sendfile_bytes.load(),
+             (unsigned long long)ktls_sends.load(),
+             (unsigned long long)splice_bytes.load());
   return buf;
 }
 
@@ -462,11 +472,73 @@ std::string jesc(const std::string &s) {
 
 }  // namespace
 
+// Assembled-response handoff from a pool worker to the reactor's EPOLLOUT
+// writer plane: the worker parses + routes + builds the response HEAD and
+// locates the body bytes (store fd for sendfile/SSL_sendfile, pinned
+// hot-tier mapping or store key for the SSL_write pump), then returns to
+// the pool immediately — the reactor drives the state below against a
+// non-blocking socket until drained. Ownership of fd / the hot pin moves
+// WITH the state (released by Session::end_write / the destructor), so a
+// handoff is a transfer, never a leak.
+struct WriteState {
+  enum class Kind {
+    kSendfile,  // plain HTTP: zero-copy sendfile(2) from the store fd
+    kKtls,      // MITM + kernel TLS: SSL_sendfile from the store fd
+    kSsl,       // MITM fallback: chunked non-blocking SSL_write pump
+  };
+  Kind kind = Kind::kSendfile;
+  std::string head;     // response head bytes not yet on the wire
+  size_t head_off = 0;
+  int fd = -1;                  // kSendfile/kKtls: store read fd (owned)
+  const char *hot = nullptr;    // kSsl: pinned hot-tier mapping base
+  std::string hot_key;          // non-empty → hot_release on teardown
+  std::string key;              // kSsl without a mapping: pread source
+  int64_t off = 0;   // next unsent absolute offset into the object
+  int64_t end = 0;   // absolute end offset; off == end → body drained
+  bool keep_alive = true;
+  // deferred route timing: serve_request_seconds must span the DRAIN, not
+  // just the worker's assembly — the session transfers its request clock
+  // here and the reactor observes at completion
+  bool timing = false;
+  int route = 0;
+  std::chrono::steady_clock::time_point t0;
+  bool ttfb_set = false;
+  std::chrono::steady_clock::time_point ttfb;
+  // stall-sweep bookkeeping (reactor thread only)
+  int64_t sent = 0;        // total bytes on the wire (head + body)
+  int64_t last_bytes = 0;  // `sent` at the last min-bps check
+  std::chrono::steady_clock::time_point deadline;    // absolute write bound
+  std::chrono::steady_clock::time_point last_check;  // last min-bps check
+  // kSsl pump staging (pread fallback)
+  std::string buf;
+  size_t buf_off = 0;
+};
+
+// Reactor-owned blind CONNECT tunnel: both fds sit in epoll (edge-
+// triggered, NOT oneshot — every stall is an EAGAIN, so readiness
+// transitions re-fire naturally) and each event pumps both directions
+// through a per-direction splice(2) pipe until nothing moves. Fallback
+// when pipe2/splice is unavailable: a bounded userspace buffer with the
+// same EAGAIN-driven backpressure. Direction 0 = client→upstream,
+// 1 = upstream→client.
+struct TunnelState {
+  int pipe_rd[2] = {-1, -1};
+  int pipe_wr[2] = {-1, -1};
+  size_t in_pipe[2] = {0, 0};     // bytes parked in the splice pipe
+  bool src_eof[2] = {false, false};
+  bool shut[2] = {false, false};  // half-close propagated to dst
+  bool use_splice = true;
+  std::string buf[2];             // userspace fallback (bounded)
+  std::chrono::steady_clock::time_point last_activity;
+};
+
 class Session {
  public:
   // What a serving step asks its owner to do with the connection next:
-  // close it, or hand it back to the reactor to park until readable.
-  enum class Disp { kClose, kPark };
+  // close it, park it in the reactor until readable, hand its assembled
+  // WriteState to the reactor's EPOLLOUT writer plane, or hand its wired
+  // CONNECT tunnel to the reactor's splice plane.
+  enum class Disp { kClose, kPark, kWrite, kTunnel };
 
   Session(Proxy *proxy, int client_fd) : p_(proxy) {
     client_.fd = client_fd;
@@ -481,12 +553,23 @@ class Session {
       std::lock_guard<Mutex> g(p_->sessions_mu_);
       p_->sessions_.erase(this);
     }
+    end_write(/*restore_block=*/false);  // in-flight WriteState resources
+    if (tstate_) {
+      for (int d = 0; d < 2; d++) {
+        if (tstate_->pipe_rd[d] >= 0) ::close(tstate_->pipe_rd[d]);
+        if (tstate_->pipe_wr[d] >= 0) ::close(tstate_->pipe_wr[d]);
+      }
+    }
     client_.shutdown_close();
     upstream_.shutdown_close();
     p_->conn_count_--;
   }
 
   int client_fd() const { return client_.fd; }
+  int upstream_fd() const { return upstream_.fd; }
+  WriteState *wstate() { return wstate_.get(); }
+  TunnelState *tstate() { return tstate_.get(); }
+  bool write_keep_alive() const { return wstate_ && wstate_->keep_alive; }
 
   // reactor-thread-only bookkeeping: whether this fd is registered in the
   // epoll set (first park ADDs, re-parks MOD the oneshot re-arm)
@@ -560,8 +643,15 @@ class Session {
           return mitm_continue();
         }
         p_->metrics_.tunnel++;
-        // a blind tunnel is an opaque byte stream with no request
-        // boundaries to park between — it stays worker-held for life
+        if (p_->reactor_enabled_) {
+          // reactor-owned tunnel: the worker only wires the upstream and
+          // answers 200; the byte pump lives in the reactor as a splice
+          // pair — a tunnel costs two fds and zero workers for life
+          if (tunnel_begin(authority)) return Disp::kTunnel;
+          return Disp::kClose;
+        }
+        // legacy model: an opaque byte stream with no request boundaries
+        // to park between — it stays worker-held for life
         blind_tunnel(authority);
         return Disp::kClose;
       }
@@ -571,6 +661,253 @@ class Session {
     RequestHead req;
     if (!parse_request_head(&client_, &req)) return Disp::kClose;
     return plain_continue(std::move(req));
+  }
+
+  // ---- reactor-driven writer plane (reactor thread only) ---------------
+  enum class WriteRc { kAgain, kWantRead, kDone, kError };
+
+  // Drive the pending WriteState against the non-blocking client socket
+  // until it drains, the socket stalls, or ~4 MB went out this dispatch
+  // (fairness: a fast reader must not monopolize the reactor — the
+  // oneshot EPOLLOUT re-arm fires again immediately while writable).
+  WriteRc drive_write() {
+    WriteState *ws = wstate_.get();
+    int64_t budget = 4ll << 20;
+    while (ws->head_off < ws->head.size()) {
+      size_t left = ws->head.size() - ws->head_off;
+      ssize_t n;
+      if (client_.ssl) {
+        int m = SSL_write(client_.ssl, ws->head.data() + ws->head_off,
+                          static_cast<int>(left));
+        if (m <= 0) return ssl_write_rc(m);
+        n = m;
+      } else {
+        n = ::send(client_.fd, ws->head.data() + ws->head_off, left,
+                   MSG_NOSIGNAL);
+        if (n < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return WriteRc::kAgain;
+          return WriteRc::kError;
+        }
+      }
+      ws->head_off += static_cast<size_t>(n);
+      ws->sent += n;
+    }
+    if (!ws->ttfb_set && ws->sent > 0) {
+      ws->ttfb_set = true;
+      ws->ttfb = std::chrono::steady_clock::now();
+    }
+    while (ws->off < ws->end) {
+      if (budget <= 0) return WriteRc::kAgain;
+      int64_t left = ws->end - ws->off;
+      ssize_t n = 0;
+      switch (ws->kind) {
+        case WriteState::Kind::kSendfile: {
+          off_t pos = static_cast<off_t>(ws->off);
+          size_t want = static_cast<size_t>(
+              std::min<int64_t>(left, std::min<int64_t>(budget, 1ll << 20)));
+          n = ::sendfile(client_.fd, ws->fd, &pos, want);
+          if (n < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK)
+              return WriteRc::kAgain;
+            return WriteRc::kError;
+          }
+          if (n == 0) return WriteRc::kError;  // store object truncated
+          p_->metrics_.sendfile_bytes += static_cast<uint64_t>(n);
+          break;
+        }
+        case WriteState::Kind::kKtls: {
+          size_t want = static_cast<size_t>(
+              std::min<int64_t>(left, std::min<int64_t>(budget, 1ll << 20)));
+          long m = dm_ssl::api().SSL_sendfile_(
+              client_.ssl, ws->fd, static_cast<long>(ws->off), want, 0);
+          if (m <= 0) return ssl_write_rc(static_cast<int>(m));
+          n = static_cast<ssize_t>(m);
+          p_->metrics_.ktls_sends++;
+          break;
+        }
+        case WriteState::Kind::kSsl: {
+          const char *src;
+          size_t want;
+          if (ws->hot != nullptr) {
+            src = ws->hot + ws->off;
+            want = static_cast<size_t>(std::min<int64_t>(left, 64ll << 10));
+          } else {
+            if (ws->buf_off == ws->buf.size()) {  // restage off the store
+              size_t chunk =
+                  static_cast<size_t>(std::min<int64_t>(left, 256ll << 10));
+              ws->buf.resize(chunk);
+              int64_t got =
+                  p_->store_->pread(ws->key, ws->buf.data(),
+                                    static_cast<int64_t>(chunk), ws->off);
+              if (got <= 0) return WriteRc::kError;
+              ws->buf.resize(static_cast<size_t>(got));
+              ws->buf_off = 0;
+            }
+            src = ws->buf.data() + ws->buf_off;
+            want = ws->buf.size() - ws->buf_off;
+          }
+          int m = SSL_write(client_.ssl, src, static_cast<int>(want));
+          if (m <= 0) return ssl_write_rc(m);
+          if (ws->hot == nullptr) ws->buf_off += static_cast<size_t>(m);
+          n = m;
+          break;
+        }
+      }
+      ws->off += n;
+      ws->sent += n;
+      budget -= n;
+      p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
+      p_->metrics_.serve_bytes += static_cast<uint64_t>(n);
+    }
+    if (ws->timing) {
+      ws->timing = false;
+      auto now = std::chrono::steady_clock::now();
+      p_->metrics_.route_latency[ws->route].observe(
+          std::chrono::duration<double>(now - ws->t0).count());
+      p_->metrics_.route_ttfb[ws->route].observe(
+          std::chrono::duration<double>((ws->ttfb_set ? ws->ttfb : now) -
+                                        ws->t0).count());
+    }
+    return WriteRc::kDone;
+  }
+
+  // Optimistic inline drain (worker thread, right after the handoff is
+  // assembled): most clients read at line rate, and paying the reactor
+  // round-trip (eventfd wake, EPOLLOUT arm, dispatch) per response costs
+  // measurable hot-hit throughput. Pump the non-blocking socket here as
+  // long as the client keeps accepting bytes; a reader that lets the
+  // socket stay full past a short poll beat is the slow case the writer
+  // plane exists for — hand it off. Returns kDone (finished inline),
+  // kAgain (the reactor now owns the drain) or kError (transport died).
+  WriteRc drain_inline() {
+    // pass cap: a reader draining just fast enough to keep POLLOUT
+    // asserting could otherwise hold the worker for an unbounded drain;
+    // past the cap the reactor takes over (and its deadline / min-bps
+    // sweeps apply there)
+    uint64_t last = wstate_->sent;
+    for (int pass = 0; pass < 1024; ++pass) {
+      WriteRc rc = drive_write();
+      if (rc == WriteRc::kDone || rc == WriteRc::kError) return rc;
+      if (rc == WriteRc::kWantRead) return WriteRc::kAgain;  // reactor's job
+      // socket full (or fairness budget spent): wait one beat for the
+      // reader to free buffer space. Patience scales with the drain
+      // rate — a reader that just took a bulk chunk is fast and merely
+      // descheduled (common on small-core boxes), so give it a long
+      // beat rather than demote it to the reactor mid-drain; a reader
+      // that accepted only a socket-buffer dribble gets the short beat
+      // and moves to the writer plane on the first stall.
+      int patience = wstate_->sent - last >= (1u << 20) ? 25 : 2;
+      last = wstate_->sent;
+      struct pollfd pfd = {client_.fd, POLLOUT, 0};
+      int pr = ::poll(&pfd, 1, patience);
+      if (pr <= 0 || (pfd.revents & (POLLERR | POLLHUP)) != 0)
+        return pr < 0 && errno != EINTR ? WriteRc::kError : WriteRc::kAgain;
+    }
+    return WriteRc::kAgain;
+  }
+
+  // Release everything a WriteState carries (store fd, hot-tier pin).
+  // `restore_block` puts the client fd back into blocking mode — the
+  // parse path's SO_RCVTIMEO reads rely on it; the destructor skips the
+  // restore (the fd is about to close).
+  void end_write(bool restore_block) {
+    handoff_ = false;
+    if (!wstate_) return;
+    if (wstate_->fd >= 0) p_->release_read_fd(wstate_->key, wstate_->fd);
+    if (!wstate_->hot_key.empty() && p_->store_ != nullptr)
+      p_->store_->hot_release(wstate_->hot_key);
+    wstate_.reset();
+    if (restore_block) set_client_nonblock(false);
+  }
+
+  // Pump both tunnel directions until nothing moves (every stall is an
+  // EAGAIN, so the edge-triggered registration re-fires on the next
+  // readiness transition). Returns false when the tunnel is finished
+  // (both directions half-closed through) or the transport died — the
+  // caller deletes the session either way.
+  bool tunnel_pump() {
+    TunnelState *ts = tstate_.get();
+    bool progress = true;
+    while (progress) {
+      progress = false;
+      for (int d = 0; d < 2; d++) {
+        if (ts->shut[d]) continue;
+        int src = d == 0 ? client_.fd : upstream_.fd;
+        int dst = d == 0 ? upstream_.fd : client_.fd;
+        if (ts->use_splice) {
+          while (!ts->src_eof[d]) {  // src socket → pipe
+            ssize_t n = ::splice(src, nullptr, ts->pipe_wr[d], nullptr,
+                                 1 << 20, SPLICE_F_MOVE | SPLICE_F_NONBLOCK);
+            if (n > 0) {
+              ts->in_pipe[d] += static_cast<size_t>(n);
+              progress = true;
+              continue;
+            }
+            if (n == 0) {
+              ts->src_eof[d] = true;
+              break;
+            }
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN) break;  // src dry or pipe full
+            return false;
+          }
+          while (ts->in_pipe[d] > 0) {  // pipe → dst socket
+            ssize_t n = ::splice(ts->pipe_rd[d], nullptr, dst, nullptr,
+                                 ts->in_pipe[d],
+                                 SPLICE_F_MOVE | SPLICE_F_NONBLOCK);
+            if (n > 0) {
+              ts->in_pipe[d] -= static_cast<size_t>(n);
+              progress = true;
+              tunnel_account(d, n);
+              continue;
+            }
+            if (n < 0 && errno == EINTR) continue;
+            if (n < 0 && errno == EAGAIN) break;  // dst full
+            return false;
+          }
+        } else {
+          // userspace fallback: bounded buffer, same EAGAIN backpressure
+          const size_t kBufMax = 256 << 10;
+          std::string &b = ts->buf[d];
+          while (!ts->src_eof[d] && b.size() < kBufMax) {
+            char tmp[64 << 10];
+            ssize_t n = ::recv(src, tmp,
+                               std::min(sizeof tmp, kBufMax - b.size()), 0);
+            if (n > 0) {
+              b.append(tmp, static_cast<size_t>(n));
+              progress = true;
+              continue;
+            }
+            if (n == 0) {
+              ts->src_eof[d] = true;
+              break;
+            }
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN) break;
+            return false;
+          }
+          while (!b.empty()) {
+            ssize_t n = ::send(dst, b.data(), b.size(), MSG_NOSIGNAL);
+            if (n > 0) {
+              b.erase(0, static_cast<size_t>(n));
+              progress = true;
+              tunnel_account(d, n);
+              continue;
+            }
+            if (n < 0 && errno == EINTR) continue;
+            if (n < 0 && errno == EAGAIN) break;
+            return false;
+          }
+        }
+        if (ts->src_eof[d] && ts->in_pipe[d] == 0 && ts->buf[d].empty()) {
+          ::shutdown(dst, SHUT_WR);  // propagate the half-close
+          ts->shut[d] = true;
+        }
+      }
+    }
+    return !(ts->shut[0] && ts->shut[1]);
   }
 
  private:
@@ -586,6 +923,147 @@ class Session {
   // request on this connection is served against)
   std::string mitm_authority_, mitm_host_;
   int mitm_port_ = 443;
+
+  // Writer/tunnel handoff state (see WriteState/TunnelState above).
+  // handoff_ marks "this step assembled a response for the reactor to
+  // drive" — the keep-alive continue loops convert it into Disp::kWrite
+  // before interpreting the serve result.
+  bool handoff_ = false;
+  std::unique_ptr<WriteState> wstate_;
+  std::unique_ptr<TunnelState> tstate_;
+
+  // Map an SSL_write/SSL_sendfile short return onto the writer plane.
+  // WANT_READ happens mid-renegotiation: the reactor re-arms for EPOLLIN
+  // instead of EPOLLOUT and resumes the same write when bytes arrive.
+  WriteRc ssl_write_rc(int ret) {
+    int err = SSL_get_error(client_.ssl, ret);
+    if (err == DM_SSL_ERROR_WANT_WRITE) return WriteRc::kAgain;
+    if (err == DM_SSL_ERROR_WANT_READ) return WriteRc::kWantRead;
+    ERR_clear_error();
+    return WriteRc::kError;
+  }
+
+  void set_client_nonblock(bool on) {
+    int fl = ::fcntl(client_.fd, F_GETFL, 0);
+    if (fl < 0) return;
+    ::fcntl(client_.fd, F_SETFL, on ? (fl | O_NONBLOCK) : (fl & ~O_NONBLOCK));
+  }
+
+  void tunnel_account(int dir, ssize_t n) {
+    (dir == 0 ? p_->metrics_.bytes_up : p_->metrics_.bytes_down) +=
+        static_cast<uint64_t>(n);
+    p_->metrics_.splice_bytes += static_cast<uint64_t>(n);
+    tstate_->last_activity = std::chrono::steady_clock::now();
+  }
+
+  // Wire the upstream for a blind CONNECT and build the TunnelState the
+  // reactor will own: answer 200, allocate the two splice pipes (or fall
+  // back to userspace buffers when pipe2 is exhausted), and flip both
+  // sockets non-blocking. fd/pipe ownership transfers to the Session —
+  // upstream_ and tstate_ close everything in the destructor.
+  bool tunnel_begin(const std::string &authority) {
+    std::string host, err;
+    int port;
+    split_authority(authority, &host, &port, 443);
+    int up = tcp_connect(host, port, p_->cfg_.io_timeout_sec, &err);
+    if (up < 0) {
+      p_->metrics_.errors++;
+      send_simple(&client_, 502, "Bad Gateway", err);
+      return false;
+    }
+    upstream_.fd = up;
+    upstream_authority_ = authority;
+    static const char ok[] = "HTTP/1.1 200 Connection Established\r\n\r\n";
+    if (!client_.write_all(ok, sizeof ok - 1)) return false;
+    auto ts = std::make_unique<TunnelState>();
+    for (int d = 0; d < 2 && ts->use_splice; d++) {
+      int pfd[2];
+      if (::pipe2(pfd, O_NONBLOCK | O_CLOEXEC) != 0) {
+        // fd pressure: degrade this tunnel to the userspace pump
+        ts->use_splice = false;
+        break;
+      }
+      ts->pipe_rd[d] = pfd[0];
+      ts->pipe_wr[d] = pfd[1];
+    }
+    if (!ts->use_splice) {
+      for (int d = 0; d < 2; d++) {
+        if (ts->pipe_rd[d] >= 0) ::close(ts->pipe_rd[d]);
+        if (ts->pipe_wr[d] >= 0) ::close(ts->pipe_wr[d]);
+        ts->pipe_rd[d] = ts->pipe_wr[d] = -1;
+      }
+    }
+    ts->last_activity = std::chrono::steady_clock::now();
+    set_client_nonblock(true);
+    int fl = ::fcntl(up, F_GETFL, 0);
+    if (fl >= 0) ::fcntl(up, F_SETFL, fl | O_NONBLOCK);
+    tstate_ = std::move(ts);
+    return true;
+  }
+
+  // Assemble a WriteState for the reactor's EPOLLOUT writer plane and
+  // flip the client non-blocking. Returns false — leaving no state
+  // behind — when no handoff-capable body source exists (store fd gone
+  // for the plain path); the caller then streams synchronously as
+  // before. On success ownership of the store fd / hot-tier pin is
+  // inside the WriteState and end_write() releases it on the reactor.
+  bool begin_write_handoff(const RequestHead &req, const std::string &key,
+                           const std::string &head, int64_t off,
+                           int64_t len) {
+    auto ws = std::make_unique<WriteState>();
+    ws->head = head;
+    ws->key = key;
+    ws->off = off;
+    ws->end = off + len;
+    ws->keep_alive = lower(req.headers.get("connection")) != "close";
+    if (!client_.ssl) {
+      int fd = p_->shared_read_fd(key);
+      if (fd < 0) return false;
+      ws->fd = fd;
+      ws->kind = WriteState::Kind::kSendfile;
+    } else {
+      if (p_->ktls_enabled_ && p_->ktls_send_usable(client_.ssl)) {
+        int fd = p_->shared_read_fd(key);
+        if (fd >= 0) {
+          ws->fd = fd;
+          ws->kind = WriteState::Kind::kKtls;
+        }
+      }
+      if (ws->kind != WriteState::Kind::kKtls) {
+        ws->kind = WriteState::Kind::kSsl;
+        int64_t hot_size = 0;
+        const char *hot = p_->store_->hot_acquire(key, &hot_size);
+        if (!hot && p_->store_->hot_admit(key))
+          hot = p_->store_->hot_acquire(key, &hot_size);
+        if (hot && hot_size >= off + len) {
+          ws->hot = hot;
+          ws->hot_key = key;
+        } else if (hot) {
+          p_->store_->hot_release(key);  // stale size: pump off the store
+        }
+        // the non-blocking pump retries SSL_write after EAGAIN with a
+        // possibly restaged buffer — partial + moving-buffer modes make
+        // that legal
+        SSL_ctrl(client_.ssl, DM_SSL_CTRL_MODE,
+                 DM_SSL_MODE_ENABLE_PARTIAL_WRITE |
+                     DM_SSL_MODE_ACCEPT_MOVING_WRITE_BUFFER,
+                 nullptr);
+      }
+    }
+    auto now = std::chrono::steady_clock::now();
+    ws->deadline = now + std::chrono::seconds(p_->write_timeout_sec_);
+    ws->last_check = now;
+    if (req_timing_) {  // the drain finishes the request, so it owns the
+      ws->timing = true;  // route clock; the worker's route_end no-ops
+      ws->route = req_route_;
+      ws->t0 = req_t0_;
+      req_timing_ = false;
+    }
+    set_client_nonblock(true);
+    wstate_ = std::move(ws);
+    handoff_ = true;
+    return true;
+  }
 
   // Per-request route timing → the per-route latency/TTFB histograms.
   // begin/end bracket one served request in the keep-alive loops
@@ -740,6 +1218,12 @@ class Session {
 
     SSL *ssl = SSL_new(ctx);
     SSL_set_fd(ssl, client_.fd);
+    // kTLS must be requested BEFORE the handshake — OpenSSL programs the
+    // kernel TLS state as part of ChangeCipherSpec. Whether the offload
+    // actually engaged is probed per-connection at write-handoff time.
+    if (p_->ktls_enabled_ && p_->ktls_available() &&
+        dm_ssl::api().SSL_set_options_ != nullptr)
+      dm_ssl::api().SSL_set_options_(ssl, DM_SSL_OP_ENABLE_KTLS);
     if (SSL_accept(ssl) != 1) {
       p_->metrics_.errors++;
       ::fprintf(stderr, "[demodel-tpu] TLS accept from client failed (%s): %s\n",
@@ -767,6 +1251,21 @@ class Session {
       bool ok = serve_one(req, "https", mitm_authority_, mitm_host_,
                           mitm_port_, /*tls=*/true);
       route_end();
+      // a handoff means the response body is the writer plane's job —
+      // checked before the ok/keep-alive logic because even a
+      // Connection: close response still needs its body drained. Fast
+      // readers usually finish in the inline drain and never reach the
+      // reactor; only a stalled socket rides EPOLLOUT.
+      if (handoff_) {
+        WriteRc rc = drain_inline();
+        if (rc == WriteRc::kAgain) return Disp::kWrite;
+        bool ka = rc == WriteRc::kDone && wstate_->keep_alive;
+        end_write(/*restore_block=*/true);
+        if (!ka) return Disp::kClose;
+        p_->maybe_gc();
+        if (!input_buffered()) return Disp::kPark;
+        continue;
+      }
       if (!ok) return Disp::kClose;
       p_->maybe_gc();
       if (lower(req.headers.get("connection")) == "close") return Disp::kClose;
@@ -783,7 +1282,14 @@ class Session {
       route_begin();
       bool ok = plain_one(req);
       route_end();
-      if (!ok) return Disp::kClose;
+      if (handoff_) {  // body finishes inline or on the reactor
+        WriteRc rc = drain_inline();
+        if (rc == WriteRc::kAgain) return Disp::kWrite;
+        bool ka = rc == WriteRc::kDone && wstate_->keep_alive;
+        end_write(/*restore_block=*/true);
+        if (!ka) return Disp::kClose;
+      }
+      if (!handoff_ && !ok) return Disp::kClose;
       if (!input_buffered()) return Disp::kPark;
       RequestHead next;
       if (!parse_request_head(&client_, &next)) return Disp::kClose;
@@ -2157,6 +2663,13 @@ class Session {
               std::to_string(off + len - 1) + "/" +
               std::to_string(loc.nbytes) + "\r\n";
     head += "Accept-Ranges: bytes\r\nConnection: keep-alive\r\n\r\n";
+    // tensor windows are byte ranges of a cached object: same writer-
+    // plane handoff as serve_from_cache for anything beyond coalescing
+    if (p_->reactor_enabled_ && req.method != "HEAD" && len > (256ll << 10) &&
+        begin_write_handoff(req, loc.key, head, loc.start + off, len)) {
+      route_ttfb();
+      return true;
+    }
     route_ttfb();
     if (!client_.write_all(head.data(), head.size())) return false;
     if (req.method == "HEAD") return true;
@@ -2340,6 +2853,16 @@ class Session {
       return true;
     }
 
+    // writer-plane handoff: any body too big for the coalesce fast path
+    // leaves via the reactor's EPOLLOUT writer, so a slow reader holds
+    // zero workers for the drain. The head rides inside the WriteState.
+    if (p_->reactor_enabled_ && req.method != "HEAD" && len > kCoalesceMax &&
+        begin_write_handoff(req, key, head, off, len)) {
+      log_response(req, uri, status, ct, len, true);
+      route_ttfb();
+      return true;
+    }
+
     route_ttfb();
     if (!client_.write_all(head.data(), head.size())) return false;
     log_response(req, uri, status, ct, len, true);
@@ -2429,7 +2952,59 @@ Proxy::~Proxy() {
   stop();
   for (auto &p : leaf_ctxs_) SSL_CTX_free(p.second);
   if (upstream_ctx_) SSL_CTX_free(upstream_ctx_);
+  {
+    // stop() drained every session, so all refs are gone; anything left
+    // here means a release was skipped — close defensively anyway
+    std::lock_guard<Mutex> g(read_fd_mu_);
+    for (auto &e : read_fds_)
+      if (e.second.first >= 0) ::close(e.second.first);
+    read_fds_.clear();
+  }
   delete store_;
+}
+
+// One store read-fd per object key, shared by every concurrent
+// WriteState over that key: sendfile(2), SSL_sendfile and pread all
+// take explicit offsets, so the shared fd carries no cursor state.
+// Returns -1 when the store cannot open the object (evicted between
+// the lookup and the handoff).
+int Proxy::shared_read_fd(const std::string &key) {
+  {
+    std::lock_guard<Mutex> g(read_fd_mu_);
+    auto it = read_fds_.find(key);
+    if (it != read_fds_.end()) {
+      it->second.second++;
+      return it->second.first;
+    }
+  }
+  // open outside the lock (disk latency), then publish; a racing opener
+  // of the same key loses and closes its duplicate
+  int fd = store_->open_read_fd(key);
+  if (fd < 0) return -1;
+  std::lock_guard<Mutex> g(read_fd_mu_);
+  auto it = read_fds_.find(key);
+  if (it != read_fds_.end()) {
+    ::close(fd);
+    it->second.second++;
+    return it->second.first;
+  }
+  read_fds_.emplace(key, std::make_pair(fd, 1));
+  return fd;
+}
+
+void Proxy::release_read_fd(const std::string &key, int fd) {
+  std::lock_guard<Mutex> g(read_fd_mu_);
+  auto it = read_fds_.find(key);
+  if (it == read_fds_.end() || it->second.first != fd) {
+    // not cache-owned (pre-cache state or a lost-race duplicate that
+    // leaked through): close directly rather than leak
+    ::close(fd);
+    return;
+  }
+  if (--it->second.second == 0) {
+    ::close(it->second.first);
+    read_fds_.erase(it);
+  }
 }
 
 // Record/lookup content hints for signed-URL churn. Keys are
@@ -2494,11 +3069,16 @@ SSL_CTX *Proxy::leaf_ctx(const std::string &host, std::string *err) {
     if (err) *err = "no mint callback configured";
     return nullptr;
   }
-  char cert[1024], key[1024];
+  // zero-init + hard NUL cap: the mint callback is foreign code (Python
+  // ctypes in production) — the paths below must be terminated strings
+  // even if it violates the write-contract
+  char cert[1024] = {0}, key[1024] = {0};
   if (cfg_.mint(host.c_str(), cert, key, sizeof cert) != 0) {
     if (err) *err = "mint callback failed";
     return nullptr;
   }
+  cert[sizeof cert - 1] = '\0';
+  key[sizeof key - 1] = '\0';
   SSL_CTX *ctx = SSL_CTX_new(TLS_server_method());
   if (!ctx || SSL_CTX_use_certificate_chain_file(ctx, cert) != 1 ||
       SSL_CTX_use_PrivateKey_file(ctx, key, DM_SSL_FILETYPE_PEM) != 1 ||
@@ -2515,6 +3095,46 @@ SSL_CTX *Proxy::leaf_ctx(const std::string &host, std::string *err) {
   }
   leaf_ctxs_[host] = ctx;
   return ctx;
+}
+
+#ifndef TCP_ULP
+#define TCP_ULP 31  // linux/tcp.h value; absent from older libc headers
+#endif
+
+// One-time process-wide probe: can this kernel+OpenSSL pair do kTLS at
+// all? Needs the optional OpenSSL 3 symbols AND a kernel that accepts
+// the "tls" upper-layer protocol on a TCP socket (tls.ko loadable).
+// Cached under ktls_mu_ (leaf rank — held over no other acquisition).
+bool Proxy::ktls_available() {
+  std::lock_guard<Mutex> g(ktls_mu_);
+  if (ktls_state_ != 0) return ktls_state_ > 0;
+  ktls_state_ = -1;
+  const dm_ssl::Api &a = dm_ssl::api();
+  if (a.SSL_set_options_ == nullptr || a.SSL_get_wbio_ == nullptr ||
+      a.BIO_ctrl_ == nullptr || a.SSL_sendfile_ == nullptr)
+    return false;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  // an unconnected socket answers ENOTCONN when the module exists;
+  // ENOENT/ENOPROTOOPT/EINVAL mean no kernel TLS here
+  int rc = ::setsockopt(fd, IPPROTO_TCP, TCP_ULP, "tls", 3);
+  int e = rc == 0 ? 0 : errno;
+  ::close(fd);
+  if (rc != 0 && (e == ENOENT || e == ENOPROTOOPT || e == EINVAL))
+    return false;
+  ktls_state_ = 1;
+  return true;
+}
+
+// Per-connection: did THIS handshake actually engage the kernel send
+// path? (Cipher must be kTLS-capable, option set pre-handshake, ULP
+// attach succeeded.) Only then is SSL_sendfile legal on the session.
+bool Proxy::ktls_send_usable(SSL *ssl) {
+  if (!ktls_available()) return false;
+  const dm_ssl::Api &a = dm_ssl::api();
+  void *wbio = a.SSL_get_wbio_(ssl);
+  if (wbio == nullptr) return false;
+  return a.BIO_ctrl_(wbio, DM_BIO_CTRL_GET_KTLS_SEND, 0, nullptr) > 0;
 }
 
 void Proxy::register_tensor(const std::string &model_tensor, TensorLoc loc) {
@@ -2630,6 +3250,17 @@ static bool env_reactor_on() {
   return s != "0" && s != "false" && s != "off" && s != "no";
 }
 
+// DEMODEL_PROXY_KTLS: kernel-TLS sendfile opt-out — only an explicit
+// "0"/"false"/"off"/"no" disables; availability is runtime-probed anyway
+// (symbol presence + TCP_ULP "tls"), so leaving it on is always safe.
+static bool env_ktls_on() {
+  // NOLINTNEXTLINE(concurrency-mt-unsafe) — read-only env access (above)
+  const char *v = ::getenv("DEMODEL_PROXY_KTLS");
+  if (!v || !*v) return true;
+  std::string s = lower(v);
+  return s != "0" && s != "false" && s != "off" && s != "no";
+}
+
 // DEMODEL_OBS: the observability kill switch (the trace.py tier
 // contract) — only an explicit "0"/"false"/"off"/"no" disables; with it
 // off the profiler sampler never starts and /debug/profile answers 503.
@@ -2655,6 +3286,10 @@ std::string Proxy::metrics_json() {
     std::lock_guard<Mutex> g(reactor_mu_);
     metrics_.sessions_parked = parked_.size() + inbox_.size();
   }
+  metrics_.conns_writing = static_cast<uint64_t>(
+      writing_count_.load() > 0 ? writing_count_.load() : 0);
+  metrics_.tunnels_spliced = static_cast<uint64_t>(
+      tunnel_count_.load() > 0 ? tunnel_count_.load() : 0);
   // flat counters + the per-route latency histograms under "hist"
   std::string flat = metrics_.json();
   flat.pop_back();  // trailing '}'
@@ -2746,6 +3381,24 @@ std::string Proxy::statusz_json() {
                "\"stacks\":%zu,\"dropped\":%llu},",
                prun ? "true" : "false", profile_hz_, psamp, pstacks, pdrop);
     out.append(pbuf);
+  }
+  {
+    // writer-plane vitals — the EPOLLOUT writer + splice-tunnel state
+    // (tools/statusz.py --validate gates this section's schema)
+    char wbuf[320];
+    ::snprintf(wbuf, sizeof wbuf,
+               "\"writer\":{\"conns_writing\":%d,\"tunnels_spliced\":%d,"
+               "\"write_timeout_sec\":%d,\"write_min_bps\":%d,"
+               "\"ktls\":%s,\"stall_evictions\":%llu,"
+               "\"sendfile_bytes\":%llu,\"splice_bytes\":%llu},",
+               writing_count_.load() > 0 ? writing_count_.load() : 0,
+               tunnel_count_.load() > 0 ? tunnel_count_.load() : 0,
+               write_timeout_sec_, write_min_bps_,
+               ktls_enabled_ ? "true" : "false",
+               (unsigned long long)metrics_.write_stall_evictions.load(),
+               (unsigned long long)metrics_.sendfile_bytes.load(),
+               (unsigned long long)metrics_.splice_bytes.load());
+    out.append(wbuf);
   }
   out.append("\"metrics\":");
   out.append(metrics_json());
@@ -3221,10 +3874,19 @@ void Proxy::worker_loop() {
         d = s->step();
       }
       live_sessions_--;
-      if (d == Session::Disp::kPark)
-        reactor_park(s);
-      else
-        delete s;
+      switch (d) {
+        case Session::Disp::kPark:
+          reactor_submit(s, 0);
+          break;
+        case Session::Disp::kWrite:  // response body drains on the reactor
+          reactor_submit(s, 1);
+          break;
+        case Session::Disp::kTunnel:  // CONNECT tunnel rides the reactor
+          reactor_submit(s, 2);
+          break;
+        default:
+          delete s;
+      }
     } else {
       for (;;) {
         if (!s->await_next_request()) break;
@@ -3294,6 +3956,14 @@ int Proxy::start() {
   if (profile_hz_ == 0) profile_hz_ = 19;
   profile_cap_ = env_pos_int("DEMODEL_PROFILE_MAX_STACKS", 65536);
   if (profile_cap_ == 0) profile_cap_ = 2048;
+  // writer-plane knobs: the per-connection write deadline bounds any one
+  // response drain; the min-bps low watermark (off by default) evicts
+  // trickle readers long before the deadline
+  write_timeout_sec_ = env_pos_int("DEMODEL_PROXY_WRITE_TIMEOUT", 86400);
+  if (write_timeout_sec_ == 0) write_timeout_sec_ = 75;
+  write_min_bps_ = env_pos_int("DEMODEL_PROXY_WRITE_MIN_BPS", 1 << 30);
+  if (write_min_bps_ <= 0) write_min_bps_ = 0;  // unset → watermark off
+  ktls_enabled_ = env_ktls_on();
 
   if (reactor_enabled_) {
     epoll_fd_ = ::epoll_create1(EPOLL_CLOEXEC);
@@ -3451,12 +4121,15 @@ void Proxy::wake_reactor() {
 // Hand a connection (back) to the reactor. Outside the reactor thread the
 // epoll set is never touched — the inbox + eventfd funnel every (re-)arm
 // through the loop, so oneshot re-arms cannot race a concurrent dispatch.
-void Proxy::reactor_park(Session *s) {
+// kind 0 parks for EPOLLIN; kind 1 hands the session's assembled
+// WriteState to the EPOLLOUT writer plane; kind 2 adopts its wired
+// CONNECT tunnel. Ownership transfers with the submit either way.
+void Proxy::reactor_submit(Session *s, int kind) {
   bool queued = false;
   {
     std::lock_guard<Mutex> g(reactor_mu_);
     if (running_) {
-      inbox_.push_back(s);
+      inbox_.emplace_back(s, kind);
       queued = true;
     }
   }
@@ -3465,6 +4138,8 @@ void Proxy::reactor_park(Session *s) {
   else
     delete s;  // stopping: the connection closes instead of parking
 }
+
+void Proxy::reactor_park(Session *s) { reactor_submit(s, 0); }
 
 void Proxy::reactor_loop() {
   ProfileThread preg(this, "reactor");
@@ -3478,6 +4153,83 @@ void Proxy::reactor_loop() {
       expiry;
   std::vector<struct epoll_event> evs(256);
   std::vector<Session *> ready;
+  // writer/tunnel planes — reactor-thread-local (no lock: only this
+  // thread touches them); the atomics mirror the sizes for gauges
+  std::unordered_set<Session *> writing;
+  std::unordered_set<Session *> tunnels;
+
+  // (re-)arm a session for its next request and start its idle clock —
+  // shared by inbox parks and writers that finished a keep-alive body
+  auto park_now = [&](Session *s) {
+    struct epoll_event ev = {};
+    ev.events = EPOLLIN | EPOLLRDHUP | EPOLLET | EPOLLONESHOT;
+    ev.data.ptr = s;
+    if (::epoll_ctl(epoll_fd_, s->epoll_armed ? EPOLL_CTL_MOD : EPOLL_CTL_ADD,
+                    s->client_fd(), &ev) != 0) {
+      metrics_.errors++;
+      delete s;
+      return;
+    }
+    s->epoll_armed = true;
+    auto deadline = std::chrono::steady_clock::now() + idle_span;
+    {
+      std::lock_guard<Mutex> g(reactor_mu_);
+      parked_[s] = deadline;
+    }
+    expiry.emplace_back(s, deadline);
+  };
+
+  // drive one writer: re-arm on a short write (EPOLLIN instead when a
+  // renegotiating TLS peer wants bytes first), finish or kill otherwise
+  auto drive = [&](Session *s) {
+    Session::WriteRc rc = s->drive_write();
+    if (rc == Session::WriteRc::kAgain || rc == Session::WriteRc::kWantRead) {
+      struct epoll_event ev = {};
+      ev.events = (rc == Session::WriteRc::kAgain ? EPOLLOUT : EPOLLIN) |
+                  EPOLLRDHUP | EPOLLET | EPOLLONESHOT;
+      ev.data.ptr = s;
+      if (::epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, s->client_fd(), &ev) != 0) {
+        metrics_.errors++;
+        writing.erase(s);
+        writing_count_--;
+        delete s;
+      }
+      return;
+    }
+    writing.erase(s);
+    writing_count_--;
+    if (rc == Session::WriteRc::kError) {
+      delete s;
+      return;
+    }
+    // kDone: release fd/pin, restore blocking mode, then keep-alive
+    bool ka = s->write_keep_alive();
+    s->end_write(/*restore_block=*/true);
+    if (!ka) {
+      delete s;
+      return;
+    }
+    if (s->input_buffered()) {
+      // pipelined next request already buffered: straight to the pool
+      {
+        std::lock_guard<Mutex> g(queue_mu_);
+        ready_.push_back(s);
+      }
+      queue_cv_.notify_one();
+      return;
+    }
+    park_now(s);
+  };
+
+  // pump one tunnel event; a finished/broken tunnel closes here
+  auto pump = [&](Session *s) {
+    if (!s->tunnel_pump()) {
+      tunnels.erase(s);
+      tunnel_count_--;
+      delete s;
+    }
+  };
+
   for (;;) {
     int timeout_ms = -1;
     {
@@ -3499,6 +4251,11 @@ void Proxy::reactor_loop() {
         break;
       }
     }
+    // writers/tunnels need the periodic stall/idle sweeps below even
+    // when no parked deadline is pending
+    if ((!writing.empty() || !tunnels.empty()) &&
+        (timeout_ms < 0 || timeout_ms > 1000))
+      timeout_ms = 1000;
     int n = ::epoll_wait(epoll_fd_, evs.data(), static_cast<int>(evs.size()),
                          timeout_ms);
     if (!running_) break;
@@ -3518,38 +4275,75 @@ void Proxy::reactor_loop() {
         continue;
       }
       auto *s = static_cast<Session *>(evs[i].data.ptr);
+      // membership decides the plane WITHOUT dereferencing s: a session
+      // deleted earlier in this very batch (tunnel peer fd, stall kill)
+      // is in no set and its stale event falls through to a no-op
+      if (writing.count(s) > 0) {
+        drive(s);
+        continue;
+      }
+      if (tunnels.count(s) > 0) {
+        pump(s);
+        continue;
+      }
       std::lock_guard<Mutex> g(reactor_mu_);
       if (parked_.erase(s) > 0) ready.push_back(s);
     }
     // 2) arm inbox arrivals (first park ADDs, re-park MODs the spent
     // oneshot); epoll reports readiness at arm time, so bytes that landed
-    // before the arm still fire — nothing is lost in the handoff window
-    std::deque<Session *> in;
+    // before the arm still fire — nothing is lost in the handoff window.
+    // Writer submits arm EPOLLOUT (writable-now fires immediately);
+    // tunnel submits register BOTH fds edge-triggered non-oneshot.
+    std::deque<std::pair<Session *, int>> in;
     {
       std::lock_guard<Mutex> g(reactor_mu_);
       in.swap(inbox_);
     }
-    auto now = std::chrono::steady_clock::now();
-    for (Session *s : in) {
-      struct epoll_event ev = {};
-      ev.events = EPOLLIN | EPOLLRDHUP | EPOLLET | EPOLLONESHOT;
-      ev.data.ptr = s;
-      if (::epoll_ctl(epoll_fd_, s->epoll_armed ? EPOLL_CTL_MOD : EPOLL_CTL_ADD,
-                      s->client_fd(), &ev) != 0) {
-        metrics_.errors++;
-        delete s;
+    for (auto &sub : in) {
+      Session *s = sub.first;
+      if (sub.second == 1) {
+        struct epoll_event ev = {};
+        ev.events = EPOLLOUT | EPOLLRDHUP | EPOLLET | EPOLLONESHOT;
+        ev.data.ptr = s;
+        if (::epoll_ctl(epoll_fd_,
+                        s->epoll_armed ? EPOLL_CTL_MOD : EPOLL_CTL_ADD,
+                        s->client_fd(), &ev) != 0) {
+          metrics_.errors++;
+          delete s;
+          continue;
+        }
+        s->epoll_armed = true;
+        writing.insert(s);
+        writing_count_++;
         continue;
       }
-      s->epoll_armed = true;
-      auto deadline = now + idle_span;
-      {
-        std::lock_guard<Mutex> g(reactor_mu_);
-        parked_[s] = deadline;
+      if (sub.second == 2) {
+        struct epoll_event ev = {};
+        ev.events = EPOLLIN | EPOLLOUT | EPOLLRDHUP | EPOLLET;
+        ev.data.ptr = s;
+        int rc1 = ::epoll_ctl(epoll_fd_,
+                              s->epoll_armed ? EPOLL_CTL_MOD : EPOLL_CTL_ADD,
+                              s->client_fd(), &ev);
+        int rc2 = rc1 == 0 ? ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD,
+                                         s->upstream_fd(), &ev)
+                           : -1;
+        if (rc1 != 0 || rc2 != 0) {
+          metrics_.errors++;
+          delete s;
+          continue;
+        }
+        s->epoll_armed = true;
+        tunnels.insert(s);
+        tunnel_count_++;
+        // pump once now: bytes may already sit buffered on either side
+        // (the edge for them fired before registration)
+        pump(s);
+        continue;
       }
-      expiry.emplace_back(s, deadline);
+      park_now(s);
     }
     // 3) idle sweep: close parked conns past their deadline
-    now = std::chrono::steady_clock::now();
+    auto now = std::chrono::steady_clock::now();
     for (;;) {
       Session *victim = nullptr;
       {
@@ -3572,6 +4366,53 @@ void Proxy::reactor_loop() {
       metrics_.sessions_idle_closed++;
       delete victim;  // destructor closes the fd → kernel drops it from epoll
     }
+    // 3b) writer stall sweep: evict past-deadline writers and, with
+    // DEMODEL_PROXY_WRITE_MIN_BPS set, trickle readers draining below
+    // the low watermark (checked at most once per second per conn)
+    if (!writing.empty()) {
+      std::vector<Session *> dead;
+      for (Session *s : writing) {
+        WriteState *ws = s->wstate();
+        if (now >= ws->deadline) {
+          dead.push_back(s);
+          continue;
+        }
+        if (write_min_bps_ > 0) {
+          double el =
+              std::chrono::duration<double>(now - ws->last_check).count();
+          if (el >= 1.0) {
+            if (static_cast<double>(ws->sent - ws->last_bytes) <
+                static_cast<double>(write_min_bps_) * el) {
+              dead.push_back(s);
+              continue;
+            }
+            ws->last_bytes = ws->sent;
+            ws->last_check = now;
+          }
+        }
+      }
+      for (Session *s : dead) {
+        metrics_.write_stall_evictions++;
+        writing.erase(s);
+        writing_count_--;
+        delete s;
+      }
+    }
+    // 3c) tunnel idle sweep: a tunnel with no bytes either way for the
+    // io timeout closes (the legacy blind_tunnel poll bound, kept)
+    if (!tunnels.empty()) {
+      const auto tunnel_span = std::chrono::seconds(cfg_.io_timeout_sec);
+      std::vector<Session *> dead;
+      for (Session *s : tunnels)
+        if (now - s->tstate()->last_activity > tunnel_span)
+          dead.push_back(s);
+      for (Session *s : dead) {
+        metrics_.sessions_idle_closed++;
+        tunnels.erase(s);
+        tunnel_count_--;
+        delete s;
+      }
+    }
     // 4) dispatch the ready batch to the pool
     if (!ready.empty()) {
       {
@@ -3584,15 +4425,23 @@ void Proxy::reactor_loop() {
         queue_cv_.notify_all();
     }
   }
-  // teardown: every connection still owned by the reactor closes here
-  std::deque<Session *> leftovers;
+  // teardown: every connection still owned by the reactor closes here —
+  // parked, queued, mid-write, and tunneled alike (the Session
+  // destructors release WriteState fds/pins and splice pipes)
+  std::deque<std::pair<Session *, int>> leftovers;
   {
     std::lock_guard<Mutex> g(reactor_mu_);
     leftovers.swap(inbox_);
-    for (auto &p : parked_) leftovers.push_back(p.first);
+    for (auto &p : parked_) leftovers.emplace_back(p.first, 0);
     parked_.clear();
   }
-  for (Session *s : leftovers) delete s;
+  for (auto &p : leftovers) delete p.first;
+  for (Session *s : writing) delete s;
+  writing.clear();
+  writing_count_ = 0;
+  for (Session *s : tunnels) delete s;
+  tunnels.clear();
+  tunnel_count_ = 0;
 }
 
 // ---------------------------------------------------------- peer fetch

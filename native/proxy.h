@@ -166,6 +166,14 @@ struct Metrics {
   std::atomic<uint64_t> sessions_active{0}, sessions_queue_depth{0},
       sessions_rejected{0}, serve_bytes{0}, sessions_idle_closed{0},
       sessions_parked{0}, reactor_wakeups{0};
+  // zero-copy writer plane: conns_writing / tunnels_spliced are gauges
+  // (connections the reactor currently drives as EPOLLOUT writers /
+  // CONNECT splice tunnels — zero workers held either way); the rest are
+  // counters: write-deadline + min-bps stall evictions, plain sendfile
+  // byte volume, kTLS SSL_sendfile calls, tunnel splice byte volume.
+  std::atomic<uint64_t> conns_writing{0}, tunnels_spliced{0},
+      write_stall_evictions{0}, sendfile_bytes{0}, ktls_sends{0},
+      splice_bytes{0};
   std::string json() const;
 };
 
@@ -363,16 +371,54 @@ class Proxy {
   // push, but the rank order documents the one legal nesting direction).
   void reactor_loop();
   void reactor_park(Session *s);
+  // worker→reactor handoff: kind 0 = park (await EPOLLIN), 1 = adopt the
+  // session's WriteState as an EPOLLOUT-driven writer, 2 = adopt its
+  // wired CONNECT tunnel as a reactor-owned splice pair. Ownership of
+  // the Session (and every fd / hot-tier pin its state carries)
+  // TRANSFERS to the reactor thread; when stopping, the submit deletes
+  // the session instead (its destructor releases the carried resources).
+  void reactor_submit(Session *s, int kind);
   void wake_reactor();
   Mutex reactor_mu_{kRankProxyReactor};
   std::unordered_map<Session *, std::chrono::steady_clock::time_point> parked_;
-  std::deque<Session *> inbox_;
+  std::deque<std::pair<Session *, int>> inbox_;  // (session, submit kind)
   std::thread reactor_thread_;
   int epoll_fd_ = -1;
   int event_fd_ = -1;
   bool reactor_enabled_ = false;  // resolved serve model (start())
   int max_conns_ = 0;             // resolved admission bound (start())
   std::atomic<int> conn_count_{0};  // live Session objects (all states)
+
+  // zero-copy writer plane (reactor-owned). Large cache-hit responses are
+  // assembled by a worker (head + store fd / hot-tier mapping + window)
+  // and handed to the reactor, which drives them with sendfile(2) /
+  // SSL_sendfile / a non-blocking SSL_write pump under edge-triggered
+  // oneshot EPOLLOUT — a trickling reader costs two fds and zero workers.
+  // Blind CONNECT tunnels ride the same plane as splice(2) pipe pairs.
+  // The counts below are live gauges mirrored into metrics_ at scrape;
+  // knobs resolve at start() (DEMODEL_PROXY_WRITE_TIMEOUT /
+  // DEMODEL_PROXY_WRITE_MIN_BPS / DEMODEL_PROXY_KTLS).
+  std::atomic<int> writing_count_{0};
+  std::atomic<int> tunnel_count_{0};
+  int write_timeout_sec_ = 75;  // per-conn write deadline (start())
+  int write_min_bps_ = 0;       // low-watermark stall sweep; 0 = off
+  bool ktls_enabled_ = true;    // DEMODEL_PROXY_KTLS (start())
+  // one-shot kernel-TLS availability probe, cached under its own leaf
+  // rank (first MITM handshake pays it, everyone else reads the cache)
+  Mutex ktls_mu_{kRankProxyKtls};
+  int ktls_state_ = 0;  // 0 unprobed, 1 available, -1 unavailable
+  bool ktls_available();
+  bool ktls_send_usable(SSL *ssl);  // post-handshake: did the wbio offload?
+
+  // shared store read-fd cache: sendfile/SSL_sendfile drive every write
+  // with an explicit offset, so ONE fd per object key serves any number
+  // of concurrent WriteStates. Without sharing, a slow-reader horde
+  // holds one store fd per connection and a C100k run doubles its fd
+  // bill. Refcounted under its own leaf rank; the last release closes.
+  Mutex read_fd_mu_{kRankProxyFdCache};
+  std::unordered_map<std::string, std::pair<int, int>> read_fds_;  // key → (fd, refs)
+  int shared_read_fd(const std::string &key);
+  void release_read_fd(const std::string &key, int fd);
 
   // telemetry snapshot ring: periodic copies of every per-route hist's
   // bucket vector + sum, diffed pairwise to answer "p99 over the last

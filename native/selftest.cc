@@ -652,11 +652,10 @@ static std::string g_cert_path, g_key_path;
 static int selftest_mint(const char *host, char *cert_out, char *key_out,
                          int cap) {
   (void)host;
-  if ((int)g_cert_path.size() >= cap || (int)g_key_path.size() >= cap)
-    return -1;
-  ::memcpy(cert_out, g_cert_path.c_str(), g_cert_path.size() + 1);
-  ::memcpy(key_out, g_key_path.c_str(), g_key_path.size() + 1);
-  return 0;
+  if (cap <= 0) return -1;
+  int cw = ::snprintf(cert_out, (size_t)cap, "%s", g_cert_path.c_str());
+  int kw = ::snprintf(key_out, (size_t)cap, "%s", g_key_path.c_str());
+  return (cw < 0 || kw < 0 || cw >= cap || kw >= cap) ? -1 : 0;
 }
 
 static size_t count_runs(const std::string &hay, const std::string &needle) {
@@ -1278,6 +1277,464 @@ static void test_single_flight(const std::string &root) {
   ::close(lfd);
 }
 
+// ---- zero-copy writer plane + reactor tunnels: slow readers hold no
+// workers, stalled writers are evicted on deadline, CONNECT tunnels are
+// byte-exact with half-close propagation, kTLS-off falls back to the
+// chunked SSL pump, and stop() reclaims in-flight WriteStates/tunnels.
+// All run under ASan+UBSan and TSan+DM_LOCK_ORDER_CHECK like the rest.
+
+// Connect with a pre-connect SO_RCVBUF cap: the advertised window stays
+// tiny, so a multi-MB response cannot fit into kernel buffers and the
+// writer plane must hold the drain until the client actually reads.
+static int slow_reader_connect(int port, int rcvbuf) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcvbuf, sizeof rcvbuf);
+  struct sockaddr_in a = {};
+  a.sin_family = AF_INET;
+  a.sin_port = htons((uint16_t)port);
+  ::inet_pton(AF_INET, "127.0.0.1", &a.sin_addr);
+  if (::connect(fd, (struct sockaddr *)&a, sizeof a) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  struct timeval tv = {30, 0};
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  return fd;
+}
+
+static bool metrics_poll(dm::Proxy *p, const char *needle, int tries = 250) {
+  for (int i = 0; i < tries; i++) {
+    if (p->metrics_json().find(needle) != std::string::npos) return true;
+    ::usleep(20 * 1000);
+  }
+  return false;
+}
+
+// Read one HTTP/1.1 response (request already sent) to Content-Length.
+static bool read_sized_response(int fd, std::string *body_out) {
+  std::string resp;
+  char buf[64 << 10];
+  size_t body_at = std::string::npos;
+  long long cl = -1;
+  for (;;) {
+    if (body_at == std::string::npos) {
+      auto hdr_end = resp.find("\r\n\r\n");
+      if (hdr_end != std::string::npos) {
+        body_at = hdr_end + 4;
+        auto clp = resp.find("Content-Length:");
+        if (clp == std::string::npos) return false;
+        cl = ::atoll(resp.c_str() + clp + 15);
+      }
+    }
+    if (body_at != std::string::npos && cl >= 0 &&
+        resp.size() >= body_at + (size_t)cl)
+      break;
+    ssize_t n = ::read(fd, buf, sizeof buf);
+    if (n <= 0) return false;
+    resp.append(buf, (size_t)n);
+  }
+  if (resp.compare(0, 12, "HTTP/1.1 200") != 0) return false;
+  if (body_out) *body_out = resp.substr(body_at, (size_t)cl);
+  return true;
+}
+
+static void test_writer_slow_reader(const std::string &root) {
+  // An 8 MB hit through a ONE-worker reactor pool with a tiny-window
+  // client: the worker must hand the drain to the EPOLLOUT writer plane
+  // and return immediately — proven by a second client getting served
+  // while the first response is still multi-MB short of drained.
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/writerstore";
+  cfg.verbose = false;
+  cfg.session_threads = 1;
+  cfg.io_timeout_sec = 60;
+  cfg.idle_timeout_sec = 30;
+  cfg.reactor = 1;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "writer proxy start");
+  int port = p->port();
+  // 8 MB: above any tcp_wmem autotune bound, so the drain cannot complete
+  // by buffering alone; 4 KB small object rides the worker coalesce path
+  std::string big(8 << 20, 'w');
+  std::string small(4 << 10, 's');
+  {
+    std::string serr;
+    dm::Store *s = dm::Store::open(root + "/writerstore", &serr);
+    CHECK(s != nullptr, "writer store open");
+    CHECK(s->put("writerbig0000001", big.data(), (int64_t)big.size(), "{}",
+                 nullptr) == 0, "writer big put");
+    CHECK(s->put("writersmall00001", small.data(), (int64_t)small.size(),
+                 "{}", nullptr) == 0, "writer small put");
+    delete s;
+  }
+  int slow = slow_reader_connect(port, 16 << 10);
+  CHECK(slow >= 0, "slow reader connect");
+  const char *req =
+      "GET /peer/object/writerbig0000001 HTTP/1.1\r\nHost: x\r\n\r\n";
+  CHECK(::write(slow, req, ::strlen(req)) == (ssize_t)::strlen(req),
+        "slow reader request");
+  CHECK(metrics_poll(p, "\"conns_writing\":1,"),
+        "writer plane took the drain");
+  // the pool's only worker is free mid-drain — a fast client gets served
+  std::string fast = pool_get(port, "/peer/object/writersmall00001");
+  auto he = fast.find("\r\n\r\n");
+  CHECK(fast.compare(0, 12, "HTTP/1.1 200") == 0 &&
+            he != std::string::npos &&
+            fast.size() - (he + 4) == small.size(),
+        "fast client served while the slow drain is in flight");
+  // now drain the slow side to completion: bytes must be exact
+  std::string got;
+  CHECK(read_sized_response(slow, &got) && got == big,
+        "slow drain bytes-exact");
+  CHECK(metrics_poll(p, "\"conns_writing\":0,"),
+        "writer retired after the drain");
+  std::string m = p->metrics_json();
+  CHECK(m.find("\"sendfile_bytes_total\":0,") == std::string::npos &&
+            m.find("\"sendfile_bytes_total\":0}") == std::string::npos,
+        "plain hit drained via sendfile");
+  ::close(slow);
+  p->stop();
+  delete p;
+}
+
+static void test_writer_deadline_eviction(const std::string &root) {
+  // A client that never reads past its window must not pin the response
+  // open forever: DEMODEL_PROXY_WRITE_TIMEOUT=1 arms a 1 s write deadline
+  // and the reactor's stall sweep evicts the conn and counts it.
+  ::setenv("DEMODEL_PROXY_WRITE_TIMEOUT", "1", 1);
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/writerstore";  // big object seeded above
+  cfg.verbose = false;
+  cfg.session_threads = 1;
+  cfg.io_timeout_sec = 60;
+  cfg.idle_timeout_sec = 30;
+  cfg.reactor = 1;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "evict proxy start");
+  ::unsetenv("DEMODEL_PROXY_WRITE_TIMEOUT");
+  int port = p->port();
+  int slow = slow_reader_connect(port, 16 << 10);
+  CHECK(slow >= 0, "evict slow connect");
+  const char *req =
+      "GET /peer/object/writerbig0000001 HTTP/1.1\r\nHost: x\r\n\r\n";
+  CHECK(::write(slow, req, ::strlen(req)) == (ssize_t)::strlen(req),
+        "evict slow request");
+  CHECK(metrics_poll(p, "\"conns_writing\":1,"),
+        "stalled drain handed to the writer plane");
+  // never read a byte more: deadline (1 s) + sweep cadence (≤1 s) → evict
+  bool evicted = false;
+  for (int i = 0; i < 500 && !evicted; i++) {
+    std::string m = p->metrics_json();
+    evicted =
+        m.find("\"write_stall_evictions_total\":0,") == std::string::npos &&
+        m.find("\"write_stall_evictions_total\":0}") == std::string::npos;
+    if (!evicted) ::usleep(20 * 1000);
+  }
+  CHECK(evicted, "stalled writer evicted on deadline");
+  CHECK(metrics_poll(p, "\"conns_writing\":0,"),
+        "evicted conn left the writer plane");
+  ::close(slow);
+  p->stop();
+  delete p;
+}
+
+static void test_tunnel_splice(const std::string &root) {
+  // Blind CONNECT through the reactor: the worker wires the upstream,
+  // answers 200, and returns; the splice pair pumps both directions at
+  // zero worker cost. Bytes-exact echo each way, half-close propagates
+  // through the pumps, and the 1-worker pool serves a plain hit while the
+  // tunnel is live.
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  CHECK(lfd >= 0, "tunnel upstream socket");
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in ua = {};
+  ua.sin_family = AF_INET;
+  ::inet_pton(AF_INET, "127.0.0.1", &ua.sin_addr);
+  CHECK(::bind(lfd, (struct sockaddr *)&ua, sizeof ua) == 0,
+        "tunnel upstream bind");
+  socklen_t ualen = sizeof ua;
+  ::getsockname(lfd, (struct sockaddr *)&ua, &ualen);
+  int up_port = ntohs(ua.sin_port);
+  CHECK(::listen(lfd, 4) == 0, "tunnel upstream listen");
+  // upstream buffers everything until the client half-closes, echoes it
+  // back, then closes — exercising EOF propagation in both directions
+  std::thread upstream([&] {
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) return;
+    std::string seen;
+    char b[64 << 10];
+    ssize_t n;
+    while ((n = ::read(cfd, b, sizeof b)) > 0) seen.append(b, (size_t)n);
+    size_t off = 0;
+    while (off < seen.size()) {
+      ssize_t w = ::send(cfd, seen.data() + off, seen.size() - off,
+                         MSG_NOSIGNAL);
+      if (w <= 0) break;
+      off += (size_t)w;
+    }
+    ::close(cfd);
+  });
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/tunstore";
+  cfg.verbose = false;
+  cfg.session_threads = 1;
+  cfg.io_timeout_sec = 60;
+  cfg.idle_timeout_sec = 30;
+  cfg.reactor = 1;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "tunnel proxy start");
+  int port = p->port();
+  {
+    std::string serr;
+    dm::Store *s = dm::Store::open(root + "/tunstore", &serr);
+    CHECK(s != nullptr, "tunnel store open");
+    std::string small(4 << 10, 't');
+    CHECK(s->put("tunsmall00000001", small.data(), (int64_t)small.size(),
+                 "{}", nullptr) == 0, "tunnel small put");
+    delete s;
+  }
+  int fd = pool_connect_timeo(port, 30);
+  CHECK(fd >= 0, "tunnel client connect");
+  char creq[128];
+  ::snprintf(creq, sizeof creq, "CONNECT 127.0.0.1:%d HTTP/1.1\r\n\r\n",
+             up_port);
+  CHECK(::write(fd, creq, ::strlen(creq)) == (ssize_t)::strlen(creq),
+        "tunnel CONNECT send");
+  std::string est;
+  char buf[64 << 10];
+  while (est.find("\r\n\r\n") == std::string::npos) {
+    ssize_t n = ::read(fd, buf, sizeof buf);
+    if (n <= 0) break;
+    est.append(buf, (size_t)n);
+  }
+  CHECK(est.find("200 Connection Established") != std::string::npos,
+        "tunnel established");
+  CHECK(metrics_poll(p, "\"tunnels_spliced\":1,"),
+        "tunnel held by the reactor");
+  // zero workers held: the pool's only worker serves a hit mid-tunnel
+  std::string other = pool_get(port, "/peer/object/tunsmall00000001");
+  CHECK(other.compare(0, 12, "HTTP/1.1 200") == 0,
+        "worker free while the tunnel is live");
+  // patterned 1 MB payload so corruption (not just loss) would show
+  std::string payload(1 << 20, 0);
+  for (size_t i = 0; i < payload.size(); i++)
+    payload[i] = (char)(i * 31 + 7);
+  size_t off = 0;
+  while (off < payload.size()) {
+    size_t want = payload.size() - off;
+    if (want > (256 << 10)) want = 256 << 10;
+    ssize_t w = ::send(fd, payload.data() + off, want, MSG_NOSIGNAL);
+    CHECK(w > 0, "tunnel payload send");
+    if (w <= 0) break;
+    off += (size_t)w;
+  }
+  ::shutdown(fd, SHUT_WR);  // half-close: must reach the upstream as EOF
+  std::string echoed;
+  ssize_t n;
+  while ((n = ::read(fd, buf, sizeof buf)) > 0) echoed.append(buf, (size_t)n);
+  CHECK(n == 0, "upstream close propagated as EOF");
+  CHECK(echoed == payload, "tunnel bytes-exact in both directions");
+  ::close(fd);
+  CHECK(metrics_poll(p, "\"tunnels_spliced\":0,"), "tunnel retired");
+  std::string m = p->metrics_json();
+  CHECK(m.find("\"splice_bytes_total\":0,") == std::string::npos &&
+            m.find("\"splice_bytes_total\":0}") == std::string::npos,
+        "tunnel bytes counted");
+  upstream.join();
+  ::close(lfd);
+  p->stop();
+  delete p;
+}
+
+static void test_writer_tls_fallback(const std::string &root) {
+  // A >256 KiB MITM'd hit takes the writer plane; with kTLS disabled via
+  // DEMODEL_PROXY_KTLS=0 (and on most kernels regardless — no tls module)
+  // the drain falls back to the chunked SSL_write pump and the body still
+  // arrives byte-exact over TLS.
+  if (g_cert_path.empty()) {
+    FILE *f = ::fopen((root + "/leaf-cert.pem").c_str(), "w");
+    if (f) {
+      ::fputs(kTestCertPem, f);
+      ::fclose(f);
+    }
+    f = ::fopen((root + "/leaf-key.pem").c_str(), "w");
+    if (f) {
+      ::fputs(kTestKeyPem, f);
+      ::fclose(f);
+    }
+    g_cert_path = root + "/leaf-cert.pem";
+    g_key_path = root + "/leaf-key.pem";
+  }
+  ::setenv("DEMODEL_PROXY_KTLS", "0", 1);
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/tlswstore";
+  cfg.verbose = false;
+  cfg.mitm_all = true;
+  cfg.mint = selftest_mint;
+  cfg.session_threads = 1;
+  cfg.io_timeout_sec = 60;
+  cfg.idle_timeout_sec = 30;
+  cfg.reactor = 1;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "tls writer proxy start");
+  ::unsetenv("DEMODEL_PROXY_KTLS");
+  int port = p->port();
+  std::string body(1 << 20, 0);
+  for (size_t i = 0; i < body.size(); i++) body[i] = (char)(i * 13 + 3);
+  {
+    std::string serr;
+    dm::Store *s = dm::Store::open(root + "/tlswstore", &serr);
+    CHECK(s != nullptr, "tls writer store open");
+    CHECK(s->put(dm::key_for_uri("https://example.test:443/big"),
+                 body.data(), (int64_t)body.size(),
+                 "{\"content-type\":\"application/octet-stream\"}",
+                 nullptr) == 0, "tls writer put");
+    delete s;
+  }
+  int fd = pool_connect_timeo(port, 30);
+  CHECK(fd >= 0, "tls writer connect");
+  const char *connect_req = "CONNECT example.test:443 HTTP/1.1\r\n\r\n";
+  CHECK(::write(fd, connect_req, ::strlen(connect_req)) ==
+            (ssize_t)::strlen(connect_req), "tls writer CONNECT");
+  std::string est;
+  char buf[64 << 10];
+  while (est.find("\r\n\r\n") == std::string::npos) {
+    ssize_t n = ::read(fd, buf, sizeof buf);
+    if (n <= 0) break;
+    est.append(buf, (size_t)n);
+  }
+  CHECK(est.find("200 Connection Established") != std::string::npos,
+        "tls writer established");
+  SSL_CTX *cctx = SSL_CTX_new(TLS_client_method());
+  CHECK(cctx != nullptr, "tls writer client ctx");
+  SSL *ssl = SSL_new(cctx);
+  SSL_set_fd(ssl, fd);
+  CHECK(SSL_connect(ssl) == 1, "tls writer handshake");
+  const char *get = "GET /big HTTP/1.1\r\nHost: example.test\r\n\r\n";
+  CHECK(SSL_write(ssl, get, (int)::strlen(get)) == (int)::strlen(get),
+        "tls writer GET");
+  std::string resp;
+  size_t body_at = std::string::npos;
+  long long cl = -1;
+  for (;;) {
+    if (body_at == std::string::npos) {
+      auto hdr_end = resp.find("\r\n\r\n");
+      if (hdr_end != std::string::npos) {
+        body_at = hdr_end + 4;
+        auto clp = resp.find("Content-Length:");
+        CHECK(clp != std::string::npos, "tls writer content-length");
+        if (clp == std::string::npos) break;
+        cl = ::atoll(resp.c_str() + clp + 15);
+      }
+    }
+    if (body_at != std::string::npos && cl >= 0 &&
+        resp.size() >= body_at + (size_t)cl)
+      break;
+    int n = SSL_read(ssl, buf, sizeof buf);
+    if (n <= 0) break;
+    resp.append(buf, (size_t)n);
+  }
+  CHECK(body_at != std::string::npos && cl == (long long)body.size() &&
+            resp.size() >= body_at + body.size() &&
+            ::memcmp(resp.data() + body_at, body.data(), body.size()) == 0,
+        "TLS drain bytes-exact through the SSL pump");
+  CHECK(metrics_poll(p, "\"conns_writing\":0,"), "tls writer retired");
+  std::string m = p->metrics_json();
+  CHECK(m.find("\"ktls_sends_total\":0,") != std::string::npos ||
+            m.find("\"ktls_sends_total\":0}") != std::string::npos,
+        "kTLS opt-out respected — zero kTLS sends");
+  SSL_shutdown(ssl);
+  SSL_free(ssl);
+  SSL_CTX_free(cctx);
+  ::close(fd);
+  p->stop();
+  delete p;
+}
+
+static void test_writer_stop_inflight(const std::string &root) {
+  // stop() while a WriteState drain and a live tunnel are reactor-owned:
+  // teardown must reclaim both without hanging (ASan watches the fds and
+  // heap, TSan + DM_LOCK_ORDER_CHECK the join/rank discipline).
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  CHECK(lfd >= 0, "stop upstream socket");
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in ua = {};
+  ua.sin_family = AF_INET;
+  ::inet_pton(AF_INET, "127.0.0.1", &ua.sin_addr);
+  CHECK(::bind(lfd, (struct sockaddr *)&ua, sizeof ua) == 0,
+        "stop upstream bind");
+  socklen_t ualen = sizeof ua;
+  ::getsockname(lfd, (struct sockaddr *)&ua, &ualen);
+  int up_port = ntohs(ua.sin_port);
+  CHECK(::listen(lfd, 4) == 0, "stop upstream listen");
+  std::thread upstream([&] {
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) return;
+    char b[4096];
+    while (::read(cfd, b, sizeof b) > 0) {
+    }
+    ::close(cfd);
+  });
+  dm::ProxyConfig cfg;
+  cfg.host = "127.0.0.1";
+  cfg.port = 0;
+  cfg.store_root = root + "/writerstore";  // big object seeded above
+  cfg.verbose = false;
+  cfg.session_threads = 1;
+  cfg.io_timeout_sec = 60;
+  cfg.idle_timeout_sec = 30;
+  cfg.reactor = 1;
+  auto *p = new dm::Proxy(std::move(cfg));
+  CHECK(p->start() == 0, "stop proxy start");
+  int port = p->port();
+  int slow = slow_reader_connect(port, 16 << 10);
+  CHECK(slow >= 0, "stop slow connect");
+  const char *req =
+      "GET /peer/object/writerbig0000001 HTTP/1.1\r\nHost: x\r\n\r\n";
+  CHECK(::write(slow, req, ::strlen(req)) == (ssize_t)::strlen(req),
+        "stop slow request");
+  CHECK(metrics_poll(p, "\"conns_writing\":1,"), "drain in flight at stop");
+  int tun = pool_connect_timeo(port, 30);
+  CHECK(tun >= 0, "stop tunnel connect");
+  char creq[128];
+  ::snprintf(creq, sizeof creq, "CONNECT 127.0.0.1:%d HTTP/1.1\r\n\r\n",
+             up_port);
+  CHECK(::write(tun, creq, ::strlen(creq)) == (ssize_t)::strlen(creq),
+        "stop tunnel CONNECT");
+  std::string est;
+  char buf[4096];
+  while (est.find("\r\n\r\n") == std::string::npos) {
+    ssize_t n = ::read(tun, buf, sizeof buf);
+    if (n <= 0) break;
+    est.append(buf, (size_t)n);
+  }
+  CHECK(est.find("200 Connection Established") != std::string::npos,
+        "stop tunnel established");
+  CHECK(metrics_poll(p, "\"tunnels_spliced\":1,"), "tunnel live at stop");
+  CHECK(::send(tun, "ping", 4, MSG_NOSIGNAL) == 4, "stop tunnel bytes");
+  auto t0 = std::chrono::steady_clock::now();
+  p->stop();
+  double secs = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0).count();
+  CHECK(secs < 20.0, "stop() reclaimed in-flight writer and tunnel");
+  delete p;
+  ::close(slow);
+  ::close(tun);
+  upstream.join();
+  ::close(lfd);
+}
+
 int main() {
   // the data plane's raw sends carry MSG_NOSIGNAL, but OpenSSL's socket
   // BIO does not — a peer-closed TLS conn must surface as EPIPE/CHECK
@@ -1303,6 +1760,11 @@ int main() {
   test_peer_window_fetch(root);
   test_hot_tier(root);
   test_single_flight(root);
+  test_writer_slow_reader(root);
+  test_writer_deadline_eviction(root);
+  test_tunnel_splice(root);
+  test_writer_tls_fallback(root);
+  test_writer_stop_inflight(root);
   if (failures) {
     ::fprintf(stderr, "%d failures\n", failures);
     return 1;

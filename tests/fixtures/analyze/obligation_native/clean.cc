@@ -46,3 +46,30 @@ void add_only_registration(int ep, int fd) {
   struct epoll_event ev = {};
   epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);  // long-lived: DEL at teardown
 }
+
+struct TunnelState {
+  int pipe_rd_ = -1;
+  int pipe_wr_ = -1;
+};
+
+bool pipes_transferred(TunnelState *ts) {
+  int pfd[2];
+  if (::pipe2(pfd, O_NONBLOCK) != 0) return false;
+  ts->pipe_rd_ = pfd[0];  // the tunnel owns both ends now
+  ts->pipe_wr_ = pfd[1];
+  return true;
+}
+
+bool pipes_disciplined(char *buf, long n) {
+  int pfd[2];
+  if (::pipe2(pfd, O_NONBLOCK) != 0) return false;
+  long rc = ::read(pfd[0], buf, n);
+  if (rc < 0) {
+    ::close(pfd[0]);  // released before the error exit
+    ::close(pfd[1]);
+    return false;
+  }
+  ::close(pfd[0]);
+  ::close(pfd[1]);
+  return true;
+}

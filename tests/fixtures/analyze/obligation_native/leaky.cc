@@ -40,3 +40,12 @@ void pin_leak(Store *s, const char *key, char *out, long n) {
   memcpy(out, m, n);
   s->hot_release(key);
 }
+
+int splice_pipe_leak(bool shutting_down) {
+  int pfd[2];
+  if (::pipe2(pfd, O_NONBLOCK) != 0) return -1;
+  if (shutting_down) return -1;  // leaks both pipe ends
+  ::close(pfd[0]);
+  ::close(pfd[1]);
+  return 0;
+}

@@ -200,6 +200,7 @@ GOLDEN = {
         ("obligation-leak", 28),  # leaky.cc: SSL early exit (line shared
         #                           with the py mmap case above — sets)
         ("obligation-leak", 37),  # leaky.cc: dropped hot pin
+        ("obligation-leak", 46),  # leaky.cc: splice pipe pair leaked
     },
     # the cross-module taint pair: silent when analyzed alone (neither
     # half shows both the device producer and the sync) — the findings

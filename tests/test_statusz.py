@@ -418,6 +418,11 @@ def test_native_statusz_endpoint(tmp_path):
         assert set(doc["config"]) >= {"reactor", "session_threads",
                                       "max_conns", "idle_timeout_sec"}
         assert "hist" in doc["metrics"]
+        # writer plane vitals (EPOLLOUT writer / splice tunnels)
+        assert doc["writer"]["conns_writing"] >= 0
+        assert doc["writer"]["tunnels_spliced"] >= 0
+        assert doc["writer"]["write_timeout_sec"] >= 1
+        assert isinstance(doc["writer"]["ktls"], bool)
         # the tool's schema gate accepts it
         proc = subprocess.run(
             [sys.executable, "tools/statusz.py",

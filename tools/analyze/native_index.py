@@ -220,6 +220,13 @@ NATIVE_PAIRS = (
                re.compile(r"\bepoll_ctl\s*\(\s*[^,]+,\s*EPOLL_CTL_ADD"),
                "EPOLL_CTL_DEL", entity="arg", needs_local_release=True,
                check_missing=False),
+    # splice-tunnel pipe pairs (reactor writer plane): the fd array is
+    # the acquire argument; ownership usually transfers into a
+    # TunnelState closed elsewhere, so only a function that closes the
+    # array locally is held to the no-early-exit rule
+    NativePair("pipe", "splice pipe pair (release: close)",
+               re.compile(r"\bpipe2?\s*\("), "close",
+               entity="arg", needs_local_release=True, check_missing=False),
 )
 
 

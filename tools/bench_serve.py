@@ -31,12 +31,26 @@ resuming on their next request, and the 503+Retry-After admission contract
 past ``DEMODEL_PROXY_MAX_CONNS``. On a reactor-less build it only reports
 (``c10k_ok`` null).
 
+The **C100k leg** drives the EPOLLOUT writer plane: a slow-reader horde
+(~10 KB/s drains, run in a child process so its fds and GIL don't contend
+with the measured clients) requests a multi-MB object each and trickles it
+out, so every response is writer-plane-owned for the whole leg; a
+fast-client throughput leg through the same small pool proves writers hold
+zero workers; reactor-spliced CONNECT tunnels idle alongside (a byte
+echoed both ways each); admission past ``max_conns`` still answers
+503+Retry-After; and a stall sub-leg with ``DEMODEL_PROXY_WRITE_TIMEOUT=2``
+proves trickle clients are evicted and counted. On a pre-writer build it
+only reports (``c100k_ok`` null).
+
 Env knobs: DEMODEL_SERVE_OBJ_MB (default 8), DEMODEL_SERVE_OBJECTS (4),
 DEMODEL_SERVE_CLIENTS (8), DEMODEL_SERVE_SECS (3.0), DEMODEL_SERVE_FLOOD
-(200), DEMODEL_SERVE_C10K (2500 conns), DEMODEL_SERVE_C10K_POOL (8).
+(200), DEMODEL_SERVE_C10K (2500 conns), DEMODEL_SERVE_C10K_POOL (8),
+DEMODEL_SERVE_HORDE (10000 slow readers), DEMODEL_SERVE_HORDE_POOL (8),
+DEMODEL_SERVE_TUNNELS (32), DEMODEL_SERVE_FAST_P99_SLO_MS (500).
 ``--smoke`` (or DEMODEL_SERVE_SMOKE=1) shrinks everything for CI — except
 the C10k leg, which stays at 1000 conns on a 2-worker pool so the smoke
-still proves the reactor contract at meaningful scale.
+still proves the reactor contract at meaningful scale; the C100k smoke
+runs 200 slow readers on a 2-worker pool.
 """
 
 from __future__ import annotations
@@ -45,6 +59,7 @@ import http.client
 import json
 import os
 import socket
+import subprocess
 import sys
 import tempfile
 import threading
@@ -75,6 +90,10 @@ FLOOD_CONNS = int(_env_f("DEMODEL_SERVE_FLOOD", 48 if SMOKE else 200))
 FLOOD_THREADS = 4  # the acceptance-criteria pool size
 C10K_CONNS = int(_env_f("DEMODEL_SERVE_C10K", 1000 if SMOKE else 2500))
 C10K_POOL = int(_env_f("DEMODEL_SERVE_C10K_POOL", 2 if SMOKE else 8))
+HORDE_CONNS = int(_env_f("DEMODEL_SERVE_HORDE", 200 if SMOKE else 10000))
+HORDE_POOL = int(_env_f("DEMODEL_SERVE_HORDE_POOL", 2 if SMOKE else 8))
+HORDE_TUNNELS = int(_env_f("DEMODEL_SERVE_TUNNELS", 8 if SMOKE else 32))
+FAST_P99_SLO_MS = _env_f("DEMODEL_SERVE_FAST_P99_SLO_MS", 500.0)
 
 
 def _proc_threads() -> int:
@@ -560,6 +579,344 @@ def _flood_c10k(tmp: Path) -> dict:
         print(f"[bench_serve] c10k: {out}", file=sys.stderr)
 
 
+def _horde_child(argv: list[str]) -> int:
+    """Slow-reader horde, run as a child process (``--horde-child port n
+    key``): its fd budget and GIL are separate from the measured clients.
+    Admits ``n`` keep-alive connections with an 8 KB receive buffer, sends
+    one GET for the drip object each, reports ``ADMITTED <n>``, then
+    trickle-drains (~1 KB per conn per ~100 ms pass ≈ 10 KB/s) until the
+    driver writes ``FINISH`` on stdin, and reports ``DONE <served>
+    <alive>`` — ``served`` counting conns whose response head arrived."""
+    import selectors
+
+    port, n, key = int(argv[0]), int(argv[1]), argv[2]
+    _raise_nofile(n + 512)
+    req = f"GET /peer/object/{key} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+    socks: list[socket.socket | None] = []
+    prefixes: list[bytes] = []
+    admitted = 0
+    for _ in range(n):
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            # pre-connect: pins the advertised window so a multi-MB
+            # response can never be absorbed by kernel buffers
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8192)
+            s.settimeout(30)
+            s.connect(("127.0.0.1", port))
+            s.sendall(req)
+            s.setblocking(False)
+            socks.append(s)
+            admitted += 1
+        except OSError:
+            socks.append(None)
+        prefixes.append(b"")
+    sys.stdout.write(f"ADMITTED {admitted}\n")
+    sys.stdout.flush()
+
+    def drain_pass(chunk: int) -> None:
+        for i, s in enumerate(socks):
+            if s is None:
+                continue
+            try:
+                data = s.recv(chunk)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                socks[i] = None
+                continue
+            if not data:
+                socks[i] = None
+                continue
+            if len(prefixes[i]) < 12:
+                prefixes[i] += data[:12 - len(prefixes[i])]
+
+    sel = selectors.DefaultSelector()
+    sel.register(sys.stdin, selectors.EVENT_READ)
+    finish = False
+    while not finish:
+        drain_pass(1024)
+        if sel.select(timeout=0.1):
+            finish = True  # FINISH line or driver EOF
+    # bounded final sweep: any head still in flight gets a chance to land
+    deadline = time.perf_counter() + 20
+    while (any(s is not None and len(p) < 12
+               for s, p in zip(socks, prefixes))
+           and time.perf_counter() < deadline):
+        drain_pass(65536)
+        time.sleep(0.02)
+    served = sum(1 for p in prefixes if p.startswith(b"HTTP/1.1 200"))
+    alive = sum(1 for s in socks if s is not None)
+    for s in socks:
+        if s is not None:
+            s.close()
+    try:
+        sys.stdout.write(f"DONE {served} {alive}\n")
+        sys.stdout.flush()
+    except OSError:
+        pass
+    return 0
+
+
+def _stall_subleg(tmp: Path) -> dict:
+    """Trickle clients past the write deadline: with
+    ``DEMODEL_PROXY_WRITE_TIMEOUT=2`` the reactor's stall sweep must evict
+    every never-reading client and count it — no worker ever blocks on
+    them, no fd lingers."""
+    n = 8 if SMOKE else 16
+    from demodel_tpu.store import Store
+
+    store = Store(tmp / "stall-node" / "cache" / "proxy")
+    key = "stalldrip0000001"
+    # 8 MB: past what sndbuf autotune (tcp_wmem caps at ~4 MB) plus the
+    # pinned 8 KB rcvbuf can absorb, so the stall is real
+    store.put(key, os.urandom(1 << 20) * 8,
+              {"content-type": "application/octet-stream"})
+    store.close()
+    os.environ.update({
+        "DEMODEL_PROXY_THREADS": "2",
+        "DEMODEL_PROXY_WRITE_TIMEOUT": "2",
+    })
+    try:
+        node = _node(tmp / "stall-node").start()
+    finally:
+        for k in ("DEMODEL_PROXY_THREADS", "DEMODEL_PROXY_WRITE_TIMEOUT"):
+            del os.environ[k]
+    out: dict = {"conns": n}
+    socks: list[socket.socket] = []
+    try:
+        if "write_stall_evictions_total" not in node.metrics():
+            out["evict_ok"] = None  # pre-writer build: report-only
+            return out
+        req = f"GET /peer/object/{key} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        for _ in range(n):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8192)
+            s.settimeout(30)
+            s.connect(("127.0.0.1", node.port))
+            s.sendall(req)
+            socks.append(s)  # never read a byte: a pure stall
+        deadline = time.perf_counter() + 30
+        evictions = 0
+        while time.perf_counter() < deadline:
+            evictions = node.metrics()["write_stall_evictions_total"]
+            if evictions >= n:
+                break
+            time.sleep(0.2)
+        out["evictions"] = evictions
+        out["evict_ok"] = evictions >= n
+        return out
+    finally:
+        for s in socks:
+            s.close()
+        node.stop()
+        print(f"[bench_serve] stall: {out}", file=sys.stderr)
+
+
+def _c100k(tmp: Path) -> dict:
+    """The C100k writer-plane leg — see the module docstring. Gates: the
+    whole horde admitted with zero silent drops, every response
+    writer-plane-owned (``conns_writing`` gauge), fast clients through the
+    same pool unaffected (reqs flow, p99 under the SLO — with 10k writers
+    on an 8-worker pool, writers holding workers would starve this leg
+    outright), every tunnel spliced and echoing, the 503+Retry-After
+    admission contract intact, and stalled writers evicted and counted."""
+    horde_n, pool = HORDE_CONNS, HORDE_POOL
+    _raise_nofile(horde_n + 8 * HORDE_TUNNELS + 4096)
+    keys = _warm_store(tmp / "c100k-node" / "cache", 2, OBJ_MB)
+    from demodel_tpu.store import Store
+
+    store = Store(tmp / "c100k-node" / "cache" / "proxy")
+    drip_key = "c100kdrip0000001"
+    # 8 MB: past the worker-coalesce bound AND past what sndbuf autotune
+    # (tcp_wmem caps at ~4 MB) plus the horde's pinned 8 KB rcvbuf can
+    # absorb, so every horde response stays writer-owned all leg long
+    store.put(drip_key, os.urandom(1 << 20) * 8,
+              {"content-type": "application/octet-stream"})
+    store.close()
+    max_conns = horde_n + HORDE_TUNNELS + N_CLIENTS + 64
+    os.environ.update({
+        "DEMODEL_PROXY_THREADS": str(pool),
+        "DEMODEL_PROXY_MAX_CONNS": str(max_conns),
+        "DEMODEL_PROXY_IDLE_TIMEOUT": "300",
+        # the horde legitimately trickles for the whole leg; eviction is
+        # the stall sub-leg's business, not this one's
+        "DEMODEL_PROXY_WRITE_TIMEOUT": "600",
+    })
+    try:
+        node = _node(tmp / "c100k-node").start()
+    finally:
+        for k in ("DEMODEL_PROXY_THREADS", "DEMODEL_PROXY_MAX_CONNS",
+                  "DEMODEL_PROXY_IDLE_TIMEOUT",
+                  "DEMODEL_PROXY_WRITE_TIMEOUT"):
+            del os.environ[k]
+    writer = "conns_writing" in node.metrics()
+    out: dict = {"horde_conns": horde_n, "pool_threads": pool,
+                 "tunnels": HORDE_TUNNELS, "writer": writer}
+    child = None
+    lsock = None
+    tun_socks: list[socket.socket] = []
+    held_upstream: list[socket.socket] = []
+    try:
+        if not writer:
+            out["c100k_ok"] = None  # pre-writer build: report-only
+            return out
+
+        # 1) CONNECT tunnels: reactor-spliced, two fds and zero workers
+        # each; one byte echoed both ways proves each pump end-to-end
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(HORDE_TUNNELS)
+        up_port = lsock.getsockname()[1]
+
+        def upstream() -> None:
+            for _ in range(HORDE_TUNNELS):
+                try:
+                    c, _ = lsock.accept()
+                except OSError:
+                    return
+                c.settimeout(20)
+                try:
+                    d = c.recv(16)
+                    if d:
+                        c.sendall(d)
+                except OSError:
+                    pass
+                held_upstream.append(c)  # hold the tunnel open
+
+        upt = threading.Thread(target=upstream)
+        upt.start()
+        tun_echoed = 0
+        for _ in range(HORDE_TUNNELS):
+            s = socket.create_connection(("127.0.0.1", node.port),
+                                         timeout=20)
+            s.settimeout(20)
+            tun_socks.append(s)
+            s.sendall(f"CONNECT 127.0.0.1:{up_port} HTTP/1.1\r\n\r\n"
+                      .encode())
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+            if b"200 Connection Established" not in buf:
+                continue
+            try:
+                s.sendall(b"ping")
+                if s.recv(16) == b"ping":
+                    tun_echoed += 1
+            except OSError:
+                pass
+        upt.join(timeout=30)
+        out["tunnels_echoed"] = tun_echoed
+
+        # 2) admit the horde from the child process
+        child = subprocess.Popen(
+            [sys.executable, str(Path(__file__).resolve()), "--horde-child",
+             str(node.port), str(horde_n), drip_key],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        t0 = time.perf_counter()
+        parts = (child.stdout.readline() or "").split()
+        admitted = int(parts[1]) if parts and parts[0] == "ADMITTED" else 0
+        out["admitted"] = admitted
+        out["admit_secs"] = round(time.perf_counter() - t0, 2)
+
+        # 3) every admitted response lands in the writer plane (arming is
+        # async behind the worker pool — the gauge converges)
+        deadline = time.perf_counter() + 60
+        writing = 0
+        m = node.metrics()
+        while time.perf_counter() < deadline:
+            m = node.metrics()
+            writing = m["conns_writing"]
+            if writing >= admitted:
+                break
+            time.sleep(0.1)
+        out["conns_writing_peak"] = writing
+        out["tunnels_spliced"] = m["tunnels_spliced"]
+
+        # 4) fast clients through the same pool while the horde trickles
+        reqs, nbytes, lats = _hammer(
+            node.port,
+            lambda w, i: f"/peer/object/{keys[(w + i) % len(keys)]}",
+            LEG_SECS, N_CLIENTS, expect_body=True)
+        out["fast_mb_s_with_horde"] = round(nbytes / 1e6 / LEG_SECS, 2)
+        out["fast_p99_ms_with_horde"] = round(
+            _percentile(lats, 99) * 1e3, 3)
+        out["fast_reqs_with_horde"] = reqs
+
+        # 5) admission past max_conns: a real answer for every probe, the
+        # overflow a 503 + Retry-After — never a silent drop
+        probes = max(16, max_conns - admitted - HORDE_TUNNELS + 16)
+        served = rejected = retry_after = other = 0
+        probe_socks = []
+        for _ in range(probes):
+            try:
+                s = socket.create_connection(("127.0.0.1", node.port),
+                                             timeout=30)
+                probe_socks.append(s)
+                status, _body, head = _ka_get(s, f"/peer/meta/{keys[0]}")
+                if status == 200:
+                    served += 1
+                elif status == 503:
+                    rejected += 1
+                    if b"Retry-After:" in head:
+                        retry_after += 1
+                else:
+                    other += 1
+            except OSError:
+                other += 1
+        out["overflow"] = {
+            "probes": probes, "served": served, "rejected_503": rejected,
+            "rejected_with_retry_after": retry_after, "other": other,
+        }
+        for s in probe_socks:
+            s.close()
+
+        # 6) finish: the child reports response heads seen + conns alive
+        child.stdin.write("FINISH\n")
+        child.stdin.flush()
+        parts = (child.stdout.readline() or "").split()
+        done = len(parts) == 3 and parts[0] == "DONE"
+        out["horde_served_heads"] = int(parts[1]) if done else 0
+        out["horde_alive_at_finish"] = int(parts[2]) if done else 0
+        out["horde_drops"] = horde_n - out["horde_served_heads"]
+        child.wait(timeout=60)
+        child = None
+
+        m = node.metrics()
+        out["native"] = {k: m[k] for k in (
+            "conns_writing", "tunnels_spliced", "sendfile_bytes_total",
+            "splice_bytes_total", "write_stall_evictions_total",
+            "ktls_sends_total") if k in m}
+    finally:
+        if child is not None and child.poll() is None:
+            child.kill()
+        for s in tun_socks + held_upstream:
+            s.close()
+        if lsock is not None:
+            lsock.close()
+        node.stop()
+        print(f"[bench_serve] c100k: {out}", file=sys.stderr)
+
+    stall = _stall_subleg(tmp)
+    out["stall"] = stall
+    out["c100k_ok"] = (
+        admitted == horde_n
+        and out["horde_drops"] == 0
+        and out["conns_writing_peak"] >= int(0.98 * admitted)
+        and tun_echoed == HORDE_TUNNELS
+        and out["tunnels_spliced"] == HORDE_TUNNELS
+        and reqs > 0
+        and out["fast_p99_ms_with_horde"] <= FAST_P99_SLO_MS
+        and other == 0
+        and rejected >= 1
+        and retry_after == rejected
+        and stall["evict_ok"] is True
+    )
+    return out
+
+
 def main() -> int:
     t_setup = time.perf_counter()
     with tempfile.TemporaryDirectory() as td:
@@ -600,12 +957,18 @@ def main() -> int:
 
         flood = _flood(tmp)
         c10k = _flood_c10k(tmp)
+        c100k = _c100k(tmp)
         profile = _profile_leg(tmp) if PROFILE else None
         if c10k.get("hot_mb_s_with_parked") and out.get("object_mb_s"):
             # active-request throughput with ~C10K conns parked vs the
             # plain leg — the "parked conns are free" claim, quantified
             c10k["hot_vs_unparked_ratio"] = round(
                 c10k["hot_mb_s_with_parked"] / out["object_mb_s"], 3)
+        if c100k.get("fast_mb_s_with_horde") and out.get("object_mb_s"):
+            # fast-client throughput with the slow-reader horde trickling
+            # vs the plain leg — the "writers hold zero workers" claim
+            c100k["fast_vs_unparked_ratio"] = round(
+                c100k["fast_mb_s_with_horde"] / out["object_mb_s"], 3)
 
     result = {
         "metric": "serve_hot_hit_throughput",
@@ -620,6 +983,7 @@ def main() -> int:
         **out,
         "flood": flood,
         "c10k": c10k,
+        "c100k": c100k,
         **({"profile": profile} if profile is not None else {}),
         **({"native_serve_bytes_total": native["serve_bytes_total"]}
            if "serve_bytes_total" in native else {}),
@@ -630,6 +994,10 @@ def main() -> int:
         return 1
     if c10k.get("c10k_ok") is False:
         print("[bench_serve] C10K CONTRACT VIOLATED", file=sys.stderr)
+        return 1
+    if c100k.get("c100k_ok") is False:
+        print("[bench_serve] C100K WRITER CONTRACT VIOLATED",
+              file=sys.stderr)
         return 1
     if out.get("hist_p99_agree") is False:
         print("[bench_serve] HISTOGRAM/CLIENT P99 DISAGREE", file=sys.stderr)
@@ -642,4 +1010,7 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--horde-child" in sys.argv:
+        at = sys.argv.index("--horde-child")
+        sys.exit(_horde_child(sys.argv[at + 1:at + 4]))
     sys.exit(main())

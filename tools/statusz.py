@@ -227,6 +227,17 @@ def validate(doc: dict, source: str) -> None:
         raise SystemExit(f"{source}: statusz v2 missing 'tiers'")
     if native and "hist" not in doc["metrics"]:
         raise SystemExit(f"{source}: native metrics missing histograms")
+    if native:
+        # the zero-copy writer plane's vitals (EPOLLOUT writer + splice
+        # tunnels) — consumers size slow-client eviction off these
+        writer = doc.get("writer")
+        if not isinstance(writer, dict):
+            raise SystemExit(f"{source}: native statusz missing 'writer'")
+        for key in ("conns_writing", "tunnels_spliced", "write_timeout_sec",
+                    "write_min_bps", "ktls", "stall_evictions",
+                    "sendfile_bytes", "splice_bytes"):
+            if key not in writer:
+                raise SystemExit(f"{source}: writer section missing {key!r}")
     if not native:
         for knob in doc["config"].values():
             if not (isinstance(knob, dict) and "value" in knob
