@@ -181,6 +181,10 @@ struct Metrics {
 // streams the full object into partial/<key> while attached readers wait on
 // (total, written) to serve their windows from the growing partial.
 struct FillState {
+  // Deliberately out of the rank scheme: std::condition_variable
+  // requires a raw std::mutex via unique_lock, and fill waiters acquire
+  // nothing while holding it (leaf by construction; see lock_order.h).
+  // demodel: allow(native-lock-order, surface-parity) — unrankable cv partner, leaf-only
   std::mutex mu;
   std::condition_variable cv;
   int64_t total = -1;   // -1 until the upstream response head arrives
@@ -461,6 +465,7 @@ class Proxy {
   // mutex + cv pairing the sampler's timed sleep with stop()'s wakeup —
   // std::condition_variable requires std::unique_lock<std::mutex>, and
   // nothing is ever acquired under it
+  // demodel: allow(native-lock-order, surface-parity) — unrankable cv partner, leaf-only
   std::mutex profile_wake_mu_;
   std::condition_variable profile_wake_cv_;
 };

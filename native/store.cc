@@ -128,10 +128,20 @@ RangeWriter::~RangeWriter() {
 
 int RangeWriter::pwrite_at(const void *buf, int64_t len, int64_t off) {
   if (off < 0 || len < 0 || off + len > total_) return -EINVAL;
+  int fd;
+  {
+    // snapshot the fd under mu_: a concurrent commit()/abort() closes
+    // fd_ and the kernel recycles the descriptor number — a write
+    // through the stale value would land in an unrelated file. The
+    // snapshot fails fast on the finished-writer misuse instead.
+    std::lock_guard<std::mutex> g(mu_);
+    if (done_ || fd_ < 0) return -EINVAL;
+    fd = fd_;
+  }
   const char *p = static_cast<const char *>(buf);
   int64_t left = len, pos = off;
   while (left > 0) {
-    ssize_t n = ::pwrite(fd_, p, static_cast<size_t>(left), pos);
+    ssize_t n = ::pwrite(fd, p, static_cast<size_t>(left), pos);
     if (n < 0) {
       if (errno == EINTR) continue;
       return -errno;
@@ -171,19 +181,24 @@ int64_t RangeWriter::written() const {
 
 int RangeWriter::commit(const std::string &meta_json,
                         const std::string &expected_digest, char *digest_out) {
-  if (done_) return -EINVAL;
+  int fd;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    if (done_ || fd_ < 0) return -EINVAL;
+    fd = fd_;
+  }
   if (written() != total_) {
     abort(false);
     return -EIO;
   }
-  ::fsync(fd_);
+  ::fsync(fd);
   // single sequential hash pass (EVP sha256 runs multi-GB/s with SHA-NI;
   // keeping it out of the per-range loops lets N sockets write at line rate)
   Sha256 sha;
   std::vector<char> buf(4 << 20);
   int64_t off = 0;
   while (off < total_) {
-    ssize_t n = ::pread(fd_, buf.data(), buf.size(),  off);
+    ssize_t n = ::pread(fd, buf.data(), buf.size(),  off);
     if (n < 0) {
       if (errno == EINTR) continue;
       int e = -errno;
@@ -203,20 +218,26 @@ int RangeWriter::commit(const std::string &meta_json,
     abort(false);
     return -EBADMSG;
   }
-  ::close(fd_);
-  fd_ = -1;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    ::close(fd_);
+    fd_ = -1;
+    done_ = true;
+  }
   int rc = store_->publish(key_, meta_json, digest);
-  done_ = true;
   store_->finish_writer(key_);
   return rc;
 }
 
 int RangeWriter::abort(bool keep_partial) {
-  if (done_) return -EINVAL;
-  if (fd_ >= 0) ::close(fd_);
-  fd_ = -1;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    if (done_) return -EINVAL;
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+    done_ = true;
+  }
   if (!keep_partial) ::unlink(store_->part_path(key_).c_str());
-  done_ = true;
   store_->finish_writer(key_);
   return 0;
 }

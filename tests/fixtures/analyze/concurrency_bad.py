@@ -1,0 +1,7 @@
+# demodel: concurrency-native=concurrency_native
+"""Anchor for the native-concurrency golden fixtures: the pragma above
+points the three native rules at the miniature tree in
+concurrency_native/ (racy.cc carries one of every violation shape;
+clean.cc is the silent-control half of the contract)."""
+
+ANCHORED = True

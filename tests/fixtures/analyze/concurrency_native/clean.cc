@@ -1,0 +1,75 @@
+// Silent controls for the native-concurrency rules: cross-function
+// lock composition (bump_locked has no guard of its own but every
+// caller holds queue_mu_), the documented inbox/eventfd handoff edge
+// (submit pushes under the inbox lock then wakes the reactor),
+// reactor-owned state touched only on the reactor root, ranks acquired
+// in strictly increasing order, and an atomic mutated only through RMW.
+#include "lock_order.h"
+
+struct Relay {
+  Mutex queue_mu_{kRankHubQueue};
+  Mutex state_mu_{kRankHubState};
+  std::atomic<long> seq_{0};
+  int jobs_ = 0;
+  int parked_ = 0;
+  std::vector<int> inbox_;
+  std::vector<std::thread> workers_;
+  std::thread reactor_thread_;
+  int epoll_fd_ = -1;
+  int wake_fd_ = -1;
+  void start();
+  void worker_loop();
+  void reactor_loop();
+  void bump_locked();
+  void submit(int v);
+  void wake();
+  void ordered();
+};
+
+void Relay::start() {
+  for (int i = 0; i < 2; i++)
+    workers_.emplace_back([this] { worker_loop(); });
+  reactor_thread_ = std::thread([this] { reactor_loop(); });
+}
+
+void Relay::bump_locked() { jobs_++; }
+
+void Relay::worker_loop() {
+  {
+    std::lock_guard<Mutex> g(queue_mu_);
+    bump_locked();
+  }
+  submit(1);
+  seq_.fetch_add(1);
+}
+
+void Relay::wake() { eventfd_write(wake_fd_, 1); }
+
+void Relay::submit(int v) {
+  {
+    std::lock_guard<Mutex> g(state_mu_);
+    inbox_.push_back(v);
+  }
+  wake();
+}
+
+void Relay::reactor_loop() {
+  struct epoll_event evs[4];
+  epoll_wait(epoll_fd_, evs, 4, -1);
+  std::vector<int> in;
+  {
+    std::lock_guard<Mutex> g(state_mu_);
+    in.swap(inbox_);
+  }
+  parked_ = static_cast<int>(in.size());
+  {
+    std::lock_guard<Mutex> g(queue_mu_);
+    bump_locked();
+  }
+  ordered();
+}
+
+void Relay::ordered() {
+  std::lock_guard<Mutex> a(queue_mu_);
+  std::lock_guard<Mutex> b(state_mu_);
+}

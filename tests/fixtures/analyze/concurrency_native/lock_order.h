@@ -1,0 +1,5 @@
+// Miniature rank table for the native-concurrency golden fixtures.
+#pragma once
+
+constexpr int kRankHubQueue = 10;
+constexpr int kRankHubState = 20;

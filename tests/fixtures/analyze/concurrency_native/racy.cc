@@ -1,0 +1,60 @@
+// Miniature native serve plane with one of every violation shape the
+// three concurrency rules catch: a worker pool and an epoll reactor
+// sharing one Hub with a lock-set race, an atomic check-then-act, an
+// unranked mutex, a rank inversion, and two worker-side touches of
+// reactor-owned state.
+#include "lock_order.h"
+
+struct Hub {
+  Mutex queue_mu_{kRankHubQueue};
+  Mutex state_mu_{kRankHubState};
+  std::mutex raw_mu_;
+  std::atomic<int> pending_{0};
+  int counter_ = 0;
+  int parked_ = 0;
+  std::vector<std::thread> workers_;
+  std::thread reactor_thread_;
+  int epoll_fd_ = -1;
+  void start();
+  void worker_loop();
+  void reactor_loop();
+  void check_then_act();
+  void inverted();
+};
+
+void Hub::start() {
+  for (int i = 0; i < 4; i++)
+    workers_.emplace_back([this] { worker_loop(); });
+  reactor_thread_ = std::thread([this] { reactor_loop(); });
+}
+
+void Hub::worker_loop() {
+  {
+    std::lock_guard<Mutex> g(queue_mu_);
+    counter_++;
+  }
+  parked_ = 1;
+  struct epoll_event ev;
+  epoll_ctl(epoll_fd_, 1, 0, &ev);
+  check_then_act();
+}
+
+void Hub::reactor_loop() {
+  struct epoll_event evs[8];
+  epoll_wait(epoll_fd_, evs, 8, -1);
+  int snapshot = counter_;
+  parked_ = 2;
+  check_then_act();
+  inverted();
+}
+
+void Hub::check_then_act() {
+  if (pending_.load() > 0) {
+    pending_.store(0);
+  }
+}
+
+void Hub::inverted() {
+  std::lock_guard<Mutex> a(state_mu_);
+  std::lock_guard<Mutex> b(queue_mu_);
+}

@@ -39,3 +39,13 @@ std::string Metrics::hist_json() const {
 
 static const char *const kTelemetryFamilyNames[] = {
     "serve_request_seconds"};
+
+// Rank-table completeness shapes: three ranked members keep their
+// constants live, raw_mu_ carries no wrapper (unranked-member finding),
+// and kRankGone is referenced nowhere (dead-rank finding at its def).
+struct Hub {
+  Mutex a_mu_{kRankA};
+  Mutex dup_mu_{kRankDup};
+  Mutex b_mu_{kRankB};
+  std::mutex raw_mu_;
+};

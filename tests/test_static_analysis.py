@@ -47,6 +47,11 @@ ALL_RULES = {
     "surface-parity",
     # the PR 16 obligation plane
     "obligation-leak",
+    # the native concurrency plane: lock-set races, static lock order,
+    # single-owner reactor discipline over the clang-free C++ index
+    "native-guarded-field",
+    "native-lock-order",
+    "reactor-ownership",
 }
 
 #: fixture file → exact expected (rule, line) findings
@@ -177,6 +182,24 @@ GOLDEN = {
         ("surface-parity", 22),   # rank mirror: drift/stale/missing
         ("surface-parity", 7),    # parity_native/lock_order.h: dup rank
         ("surface-parity", 8),    # parity_native/proxy.cc: unwindowed hist
+        ("surface-parity", 9),    # lock_order.h: kRankGone never used
+        ("surface-parity", 50),   # parity_native/proxy.cc: unranked mutex
+    },
+    # the native concurrency plane over the miniature tree in
+    # concurrency_native/: racy.cc carries one of every violation shape
+    # (lock-set race, write/write race on reactor bookkeeping, atomic
+    # check-then-act, unranked mutex, rank inversion, worker-side epoll
+    # mutation); clean.cc (cross-function lock composition, the
+    # inbox/eventfd handoff edge, reactor-root-only touches, increasing
+    # ranks, RMW-only atomic) must stay silent
+    "concurrency_bad.py": {
+        ("native-lock-order", 11),    # racy.cc: raw_mu_ has no rank
+        ("native-guarded-field", 34),  # counter_: locked write vs bare read
+        ("native-guarded-field", 36),  # parked_: unguarded write/write
+        ("reactor-ownership", 36),    # parked_ written on a worker root
+        ("reactor-ownership", 38),    # epoll_ctl on a worker root
+        ("native-guarded-field", 53),  # pending_: atomic check-then-act
+        ("native-lock-order", 59),    # queue(10) acquired under state(20)
     },
     # the obligation plane: every paired-resource leak shape on the
     # Python side (discarded, never settled, leaks-on-raise across five
@@ -260,8 +283,11 @@ def test_tree_suppressions_are_rule_scoped():
 
     import tools.analyze.passes  # noqa: F401
 
-    pat = re.compile(r"#\s*demodel:\s*allow\(([^)]*)\)")
-    for path in sorted((REPO / "demodel_tpu").rglob("*.py")):
+    pat = re.compile(r"(?:#|//)\s*demodel:\s*allow\(([^)]*)\)")
+    files = sorted((REPO / "demodel_tpu").rglob("*.py"))
+    files += sorted((REPO / "native").glob("*.h"))
+    files += sorted((REPO / "native").glob("*.cc"))
+    for path in files:
         for m in pat.finditer(path.read_text()):
             ids = {tok.strip() for tok in m.group(1).split(",")}
             assert "*" not in ids, f"blanket allow(*) in {path}"
@@ -1015,3 +1041,153 @@ def test_check_suppressions_skips_unrun_rules(tmp_path):
     res = _run_cli(["--check-suppressions", "--rule", "jit-hygiene",
                     "mod.py"], tmp_path)
     assert res.returncode == 0, res.stderr
+
+
+# ---------------------------- the native concurrency plane (this PR)
+
+
+def _native_tree(tmp_path, cc_source):
+    """A miniature anchored native tree: lock_order.h + one .cc, with
+    the anchor .py carrying the concurrency-native pragma."""
+    nat = tmp_path / "nat"
+    nat.mkdir()
+    (nat / "lock_order.h").write_text(
+        "constexpr int kRankQ = 10;\nconstexpr int kRankS = 20;\n")
+    (nat / "mod.cc").write_text(cc_source)
+    (tmp_path / "anchor.py").write_text(
+        "# demodel: concurrency-native=nat\nANCHORED = True\n")
+    return tmp_path
+
+
+def test_native_cross_function_lock_composition_stays_silent(tmp_path):
+    """A helper with no guard of its own is still protected when every
+    caller holds the lock — the caller-held intersection composes
+    through the C++ call graph, so bump() must NOT race."""
+    root = _native_tree(tmp_path, (
+        "struct W {\n"
+        "  Mutex mu_{kRankQ};\n"
+        "  int n_ = 0;\n"
+        "  std::vector<std::thread> workers_;\n"
+        "  std::thread reactor_thread_;\n"
+        "  int efd_ = -1;\n"
+        "  void start();\n"
+        "  void bump();\n"
+        "  void worker();\n"
+        "  void reactor();\n"
+        "};\n"
+        "void W::start() {\n"
+        "  for (int i = 0; i < 2; i++)\n"
+        "    workers_.emplace_back([this] { worker(); });\n"
+        "  reactor_thread_ = std::thread([this] { reactor(); });\n"
+        "}\n"
+        "void W::bump() { n_++; }\n"
+        "void W::worker() {\n"
+        "  std::lock_guard<Mutex> g(mu_);\n"
+        "  bump();\n"
+        "}\n"
+        "void W::reactor() {\n"
+        "  epoll_wait(efd_, 0, 0, -1);\n"
+        "  std::lock_guard<Mutex> g(mu_);\n"
+        "  bump();\n"
+        "}\n"))
+    active, _ = analyze_paths([root], root=root)
+    races = [f for f in active if f.rule == "native-guarded-field"]
+    assert races == [], [f.render() for f in races]
+
+
+def test_native_handoff_edge_touch_stays_silent(tmp_path):
+    """The documented inbox/eventfd pattern — push under the inbox lock,
+    then wake the reactor — is the ONE legal off-reactor write to an
+    inbox member."""
+    root = _native_tree(tmp_path, (
+        "struct R {\n"
+        "  Mutex state_mu_{kRankS};\n"
+        "  std::vector<int> inbox_;\n"
+        "  std::vector<std::thread> workers_;\n"
+        "  std::thread reactor_thread_;\n"
+        "  int efd_ = -1;\n"
+        "  int wfd_ = -1;\n"
+        "  void start();\n"
+        "  void submit(int v);\n"
+        "  void worker();\n"
+        "  void reactor();\n"
+        "};\n"
+        "void R::start() {\n"
+        "  for (int i = 0; i < 2; i++)\n"
+        "    workers_.emplace_back([this] { worker(); });\n"
+        "  reactor_thread_ = std::thread([this] { reactor(); });\n"
+        "}\n"
+        "void R::submit(int v) {\n"
+        "  {\n"
+        "    std::lock_guard<Mutex> g(state_mu_);\n"
+        "    inbox_.push_back(v);\n"
+        "  }\n"
+        "  eventfd_write(wfd_, 1);\n"
+        "}\n"
+        "void R::worker() { submit(7); }\n"
+        "void R::reactor() {\n"
+        "  epoll_wait(efd_, 0, 0, -1);\n"
+        "  std::vector<int> in;\n"
+        "  std::lock_guard<Mutex> g(state_mu_);\n"
+        "  in.swap(inbox_);\n"
+        "}\n"))
+    active, _ = analyze_paths([root], root=root)
+    owns = [f for f in active if f.rule == "reactor-ownership"]
+    assert owns == [], [f.render() for f in owns]
+
+
+def test_native_reactor_structure_touch_from_worker_fires(tmp_path):
+    """A direct epoll mutation on a worker root bypasses the handoff
+    handshake — the exact convention PR 6/17 established, now a
+    finding."""
+    root = _native_tree(tmp_path, (
+        "struct B {\n"
+        "  Mutex state_mu_{kRankS};\n"
+        "  std::vector<std::thread> workers_;\n"
+        "  std::thread reactor_thread_;\n"
+        "  int efd_ = -1;\n"
+        "  void start();\n"
+        "  void worker();\n"
+        "  void reactor();\n"
+        "};\n"
+        "void B::start() {\n"
+        "  for (int i = 0; i < 2; i++)\n"
+        "    workers_.emplace_back([this] { worker(); });\n"
+        "  reactor_thread_ = std::thread([this] { reactor(); });\n"
+        "}\n"
+        "void B::worker() {\n"
+        "  struct epoll_event ev;\n"
+        "  epoll_ctl(efd_, 1, 0, &ev);\n"
+        "}\n"
+        "void B::reactor() { epoll_wait(efd_, 0, 0, -1); }\n"))
+    active, _ = analyze_paths([root], root=root)
+    hits = [(f.rule, f.line) for f in active
+            if f.rule == "reactor-ownership"]
+    assert hits == [("reactor-ownership", 17)], hits
+
+
+def test_native_guarded_field_catches_unlocked_finish_vs_write(tmp_path):
+    """Regression shape for the RangeWriter defect this rule surfaced in
+    native/store.cc: an extern-C finisher closing/overwriting the fd
+    with no lock while a concurrent API writer reads it (multi-instance
+    api root races itself). The locked twin stays silent."""
+    root = _native_tree(tmp_path, (
+        "struct RW {\n"
+        "  std::mutex mu_;\n"
+        "  int fd_ = 0;\n"
+        "};\n"
+        'extern "C" {\n'
+        "int rw_write(RW *w) { return w->fd_; }\n"
+        "int rw_commit(RW *w) {\n"
+        "  w->fd_ = -1;\n"
+        "  return 0;\n"
+        "}\n"
+        "int rw_write_locked(RW *w) {\n"
+        "  std::lock_guard<std::mutex> g(w->mu_);\n"
+        "  return w->fd_;\n"
+        "}\n"
+        "}\n"))
+    active, _ = analyze_paths([root], root=root)
+    races = [(f.rule, f.line) for f in active
+             if f.rule == "native-guarded-field"]
+    assert races == [("native-guarded-field", 8)], races

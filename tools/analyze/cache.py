@@ -49,6 +49,7 @@ _SHARED = [
     _TOOL_DIR / "index.py",
     _TOOL_DIR / "obligations.py",
     _TOOL_DIR / "native_index.py",
+    _TOOL_DIR / "native_concurrency.py",
     _TOOL_DIR / "cache.py",
     _TOOL_DIR / "sarif.py",
     _TOOL_DIR / "__main__.py",

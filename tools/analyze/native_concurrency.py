@@ -1,0 +1,1252 @@
+"""Clang-free C++ concurrency index over a native tree.
+
+The native serve plane's concurrency discipline — lock-set guarded
+fields, the 27-rank lock order, the single-owner reactor — is checked
+dynamically (DM_LOCK_ORDER_CHECK under the TSan selftests), which sees
+exactly the interleavings the selftests drive. This module grows the
+:mod:`tools.analyze.native_index` regex-level scanner into the shared
+index three static rules need to make those invariants whole-program:
+
+- **classes + members** — every ``class``/``struct`` body parsed into
+  member declarations classified as ranked mutex (``Mutex m_{kRank…}``
+  / ``DM_RANKED``), plain mutex (``std::mutex`` or a rank-capable
+  wrapper with no rank), atomic, condition variable, thread, or data.
+- **functions with lambda splitting** — thread-entry lambdas
+  (``[this]{ worker_loop(); }``) are carved out of their enclosing
+  function into synthetic functions so accesses inside them attribute
+  to the SPAWNED thread, not the spawning one. Statements carry block
+  paths, so a lexical ``std::lock_guard`` region is exactly the suffix
+  of its block.
+- **lock regions** — ``lock_guard``/``unique_lock``/``scoped_lock``
+  declarations open a region for the rest of their block; lock names
+  canonicalize to ``Class::member`` (``fill->mu`` and ``sf_fill->mu``
+  are one logical guard: the owning object's field, RacerD-style).
+- **call graph + roots** — bare and typed-receiver calls resolved with
+  no speculation (unresolved edges stay silent); thread roots from
+  ``std::thread``/thread-vector spawn sites and the ``extern "C"`` API
+  surface, with multi-instance marking (worker pools, API callers).
+  Lifecycle functions (those constructing or joining threads) CUT the
+  root closure: code reachable only through start()/stop() runs
+  single-threaded before spawn / after join.
+- **caller-held composition** — ``must_hold(fn)`` is the intersection
+  of locks held at every call site, composed through the call graph at
+  bounded depth: a helper with no guard of its own is still protected
+  when every caller holds the lock (the Python plane's exact
+  contract).
+
+Everything the regex level cannot resolve — receivers of unknown type,
+calls with no unique target — contributes NO edge and NO access: the
+same no-speculative-edges posture as the rest of the analyzer.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.analyze.native_index import (
+    _FN_OPEN_RE,
+    _KEYWORDS,
+    _balanced,
+    _line_of,
+    _match_name,
+    strip_code,
+)
+
+#: shared anchoring pragma for the three concurrency rules — fixtures
+#: point a .py file at a miniature native tree with this
+PRAGMA_RE = re.compile(r"#\s*demodel:\s*concurrency-native=(\S+)")
+
+#: caller-held / transitive-acquisition composition bound (matches the
+#: Python guarded-field plane)
+MAX_DEPTH = 4
+
+RANK_RE = re.compile(r"constexpr\s+int\s+(kRank\w+)\s*=\s*(\d+)\s*;")
+
+#: files never indexed for concurrency: the ranked-mutex shim IS the
+#: wrapper implementation (its internal std::mutex is the mechanism,
+#: not a missing rank), and the selftest harness is single-purpose
+#: TSan-driven code with its own thread model
+EXCLUDED_FILES = ("lock_order.h", "selftest.cc")
+
+_CLASS_RE = re.compile(
+    r"\b(class|struct)\s+([A-Za-z_]\w*)\s*(?:final\s*)?(?::[^{;]*)?\{")
+
+_GUARD_RE = re.compile(
+    r"\b(?:std::)?(lock_guard|unique_lock|scoped_lock|shared_lock)\s*"
+    r"(?:<[^;>]*>)?\s+(\w+)\s*([\(\{])")
+
+_LAMBDA_RE = re.compile(
+    r"(?<![\w\)\]])\[[^\[\]]*\]\s*(?:\(([^()]*)\))?\s*(?:mutable\b\s*)?"
+    r"(?:noexcept\b\s*)?(?:->\s*[\w:<>&*\s]+?)?\{")
+
+_INIT_LIST_RE = re.compile(
+    r"\)\s*:\s*(?:[A-Za-z_][\w:]*\s*"
+    r"(?:\((?:[^()]|\([^()]*\))*\)|\{[^{}]*\})\s*,\s*)*"
+    r"[A-Za-z_][\w:]*\s*(?:\((?:[^()]|\([^()]*\))*\)|\{[^{}]*\})\s*$")
+
+_ATOMIC_OPS = (
+    "load", "store", "exchange", "fetch_add", "fetch_sub", "fetch_and",
+    "fetch_or", "fetch_xor", "compare_exchange_weak",
+    "compare_exchange_strong")
+
+_ATOMIC_OP_RE = re.compile(
+    r"(?:(\w+)\s*(?:->|\.)\s*)?(\w+)\s*\.\s*(%s)\s*\(" %
+    "|".join(_ATOMIC_OPS))
+
+_MUTATOR_RE = re.compile(
+    r"\.\s*(?:push_back|push_front|pop_back|pop_front|insert|erase|"
+    r"clear|resize|assign|reserve|append|reset|swap|"
+    r"emplace(?:_back|_front)?)\s*\(")
+
+_CALL_KEYWORDS = _KEYWORDS | {
+    "new", "delete", "case", "else", "do", "throw", "operator",
+    "static_cast", "reinterpret_cast", "const_cast", "dynamic_cast",
+    "noexcept", "alignas", "typeid", "co_return", "co_await",
+}
+
+
+def _strip_pp(text: str) -> str:
+    """Blank preprocessor lines (and their backslash continuations) —
+    offsets preserved."""
+    out = []
+    cont = False
+    for line in text.split("\n"):
+        if cont or line.lstrip().startswith("#"):
+            cont = line.rstrip().endswith("\\")
+            out.append(" " * len(line))
+        else:
+            cont = False
+            out.append(line)
+    return "\n".join(out)
+
+
+# ----------------------------------------------------------------- model
+
+
+@dataclass
+class CMember:
+    cls: str
+    name: str
+    kind: str          # mutex | atomic | cv | thread | data
+    rank: str | None   # kRank… constant for ranked mutexes
+    rel: str
+    line: int
+    type_text: str = ""
+
+
+@dataclass
+class CStmt:
+    line: int
+    text: str
+    blocks: tuple      # enclosing block ids (path from function root)
+    conds: list        # texts of enclosing/inline conditions
+    span: tuple        # (start, end) offsets in the file text
+
+
+@dataclass
+class CFunction:
+    qname: str
+    cls: str | None
+    short: str
+    rel: str
+    line: int
+    start: int
+    end: int
+    header: str
+    statements: list = field(default_factory=list)
+    block_heads: dict = field(default_factory=dict)  # block id → head text
+    is_lambda: bool = False
+    parent: str | None = None
+    api: bool = False
+    # filled by the analysis phase
+    local_types: dict = field(default_factory=dict)  # var → class name
+    locals: set = field(default_factory=set)
+    #: locals this function OWNS (value declarations and `= new Cls`
+    #: results): writes through them are pre-escape, not shared
+    owned: set = field(default_factory=set)
+    guards: list = field(default_factory=list)   # (stmt idx, lock, line)
+    held: list = field(default_factory=list)     # per-stmt frozenset
+    calls: list = field(default_factory=list)    # (callee, line, held)
+    accesses: list = field(default_factory=list)
+    lifecycle: bool = False
+
+
+@dataclass
+class Access:
+    cls: str
+    member: str
+    write: bool
+    rel: str
+    line: int
+    locks: frozenset   # lexical lock set at the site
+    fn: str
+    atomic: bool = False
+    op: str = ""
+
+
+@dataclass
+class Root:
+    key: str          # entry function qname
+    label: str        # human name (worker_loop, reactor_loop, api, …)
+    multi: bool       # more than one concurrent instance can exist
+
+
+class ConcurrencyIndex:
+    """Everything the three concurrency rules read for one native dir."""
+
+    def __init__(self) -> None:
+        self.classes: dict[str, dict[str, CMember]] = {}
+        self.functions: dict[str, CFunction] = {}
+        self.by_short: dict[str, list[str]] = {}
+        self.ranks: dict[str, tuple[int, str, int]] = {}
+        self.rank_uses: dict[str, int] = {}
+        self.member_types: dict[tuple[str, str], str] = {}
+        self.roots: dict[str, Root] = {}
+        self.fn_roots: dict[str, set[str]] = {}
+        self.reactor_roots: set[str] = set()
+        self.handoff_fns: set[str] = set()
+        self.inbox_members: set[tuple[str, str]] = set()
+        self.callers: dict[str, list] = {}
+        self._mh_memo: dict[str, frozenset] = {}
+        self._acq_memo: dict[str, dict] = {}
+        self._lambda_seq = 0
+
+    # ------------------------------------------------- composed lock sets
+    def must_hold(self, q: str, depth: int = 0,
+                  seen: set | None = None) -> frozenset:
+        """Locks held at EVERY call site of ``q``, composed through the
+        call graph to MAX_DEPTH — the caller-held half of a site's
+        effective lock set."""
+        if q in self._mh_memo:
+            return self._mh_memo[q]
+        if seen is None:
+            seen = set()
+        if depth > MAX_DEPTH or q in seen:
+            return frozenset()
+        seen.add(q)
+        callers = self.callers.get(q, [])
+        if not callers:
+            res: frozenset = frozenset()
+        else:
+            sets = [held | self.must_hold(c, depth + 1, seen)
+                    for c, held in callers]
+            res = frozenset.intersection(*sets)
+        if depth == 0:
+            self._mh_memo[q] = res
+        return res
+
+    def eff_locks(self, acc: Access) -> frozenset:
+        return acc.locks | self.must_hold(acc.fn)
+
+    def acquired_within(self, q: str, depth: int = 0,
+                        seen: set | None = None) -> dict:
+        """Ranked locks acquired by ``q`` or its callees (bounded
+        depth) → call-chain path tuple, for lock-order edge blame."""
+        if q in self._acq_memo:
+            return self._acq_memo[q]
+        if seen is None:
+            seen = set()
+        if depth > MAX_DEPTH or q in seen:
+            return {}
+        seen.add(q)
+        fn = self.functions.get(q)
+        if fn is None:
+            return {}
+        out: dict[str, tuple] = {}
+        for _idx, lock, _line in fn.guards:
+            if self.rank_of(lock) is not None:
+                out.setdefault(lock, ())
+        for callee, _line, _held in fn.calls:
+            for lock, path in self.acquired_within(
+                    callee, depth + 1, seen).items():
+                out.setdefault(lock, (callee,) + path)
+        if depth == 0:
+            self._acq_memo[q] = out
+        return out
+
+    def rank_of(self, lock: str) -> int | None:
+        name = lock.rsplit("::", 1)[-1]
+        cls = lock.rsplit("::", 1)[0] if "::" in lock else None
+        if cls and cls in self.classes:
+            mem = self.classes[cls].get(name)
+            if mem is not None and mem.rank in self.ranks:
+                return self.ranks[mem.rank][0]
+        return None
+
+    def roots_of(self, q: str) -> set[str]:
+        return self.fn_roots.get(q, set())
+
+
+# ------------------------------------------------------------ extraction
+
+
+def _parse_param_locals(idx: ConcurrencyIndex, fn: CFunction) -> None:
+    header = fn.header
+    op = header.find("(")
+    if op < 0:
+        return
+    close = op
+    depth = 0
+    for i in range(op, len(header)):
+        if header[i] == "(":
+            depth += 1
+        elif header[i] == ")":
+            depth -= 1
+            if depth == 0:
+                close = i
+                break
+    params = header[op + 1:close]
+    for part in _split_commas(params):
+        part = part.split("=", 1)[0].strip()
+        m = re.match(
+            r"(?:const\s+)?([A-Za-z_][\w:]*(?:<[^<>]*>)?)"
+            r"[\s*&]+([A-Za-z_]\w*)\s*(?:\[[^\]]*\])?$", part)
+        if not m:
+            continue
+        fn.locals.add(m.group(2))
+        base = m.group(1).rsplit("::", 1)[-1].split("<", 1)[0]
+        if base in idx.classes:
+            fn.local_types[m.group(2)] = base
+
+
+def _split_commas(text: str) -> list[str]:
+    out, depth, start = [], 0, 0
+    for i, c in enumerate(text):
+        if c in "<([{":
+            depth += 1
+        elif c in ">)]}":
+            depth -= 1
+        elif c == "," and depth <= 0:
+            out.append(text[start:i])
+            start = i + 1
+    out.append(text[start:])
+    return [p for p in (s.strip() for s in out) if p]
+
+
+_LOCAL_DECL_RE = re.compile(
+    r"^(?:const\s+)?(?!return\b|delete\b|throw\b|new\b|case\b|goto\b)"
+    r"([A-Za-z_][\w:]*(?:<[^<>]*>)?)\s*([*&]*)\s*([A-Za-z_]\w*)\s*"
+    r"(?:=(?!=)|;|\{|$|\[)")
+_CAST_DECL_RE = re.compile(
+    r"\b(?:auto\s*\*?\s*)?(\w+)\s*=\s*static_cast<\s*([A-Z]\w*)\s*\*")
+_NEW_DECL_RE = re.compile(r"\b(\w+)\s*=\s*new\s+([A-Z]\w*)\b")
+_PTR_DECL_RE = re.compile(r"\b([A-Z]\w*)\s*\*\s*(\w+)\s*[=;,):]")
+_REF_DECL_RE = re.compile(r"\b([A-Z]\w*)\s*&\s*(\w+)\s*[=;,):]")
+
+
+def _collect_locals(idx: ConcurrencyIndex, fn: CFunction) -> None:
+    _parse_param_locals(idx, fn)
+    for st in fn.statements:
+        t = st.text
+        m = _LOCAL_DECL_RE.match(t)
+        if m and m.group(1) not in ("struct", "class", "enum"):
+            name = m.group(3)
+            fn.locals.add(name)
+            base = m.group(1).rsplit("::", 1)[-1].split("<", 1)[0]
+            if base in idx.classes:
+                fn.local_types.setdefault(name, base)
+                if not m.group(2):
+                    fn.owned.add(name)  # value local: a private copy
+        for rx in (_PTR_DECL_RE, _REF_DECL_RE):
+            for dm in rx.finditer(t):
+                if dm.group(1) in idx.classes:
+                    fn.locals.add(dm.group(2))
+                    fn.local_types.setdefault(dm.group(2), dm.group(1))
+        for dm in _CAST_DECL_RE.finditer(t):
+            if dm.group(2) in idx.classes:
+                fn.local_types[dm.group(1)] = dm.group(2)
+        for dm in _NEW_DECL_RE.finditer(t):
+            if dm.group(2) in idx.classes:
+                fn.local_types.setdefault(dm.group(1), dm.group(2))
+                fn.owned.add(dm.group(1))  # fresh object, pre-escape
+
+
+def _receiver_type(idx: ConcurrencyIndex, fn: CFunction,
+                   recv: str) -> str | None:
+    if recv == "this":
+        return fn.cls
+    t = fn.local_types.get(recv)
+    if t:
+        return t
+    if fn.cls:
+        t = idx.member_types.get((fn.cls, recv))
+        if t:
+            return t
+    return None
+
+
+def _canon_lock(idx: ConcurrencyIndex, fn: CFunction, arg: str) -> str:
+    a = re.sub(r"\s+", "", arg)
+    a = a.lstrip("&*")
+    if a.startswith("this->"):
+        a = a[len("this->"):]
+    m = re.match(r"^(\w+)(?:->|\.)(\w+)$", a)
+    if m:
+        recv, name = m.group(1), m.group(2)
+        tcls = _receiver_type(idx, fn, recv)
+        if tcls and name in idx.classes.get(tcls, {}):
+            return f"{tcls}::{name}"
+        owners = [c for c, mems in sorted(idx.classes.items())
+                  if name in mems and mems[name].kind == "mutex"]
+        if len(owners) == 1:
+            return f"{owners[0]}::{name}"
+        return name
+    if fn.cls and a in idx.classes.get(fn.cls, {}):
+        return f"{fn.cls}::{a}"
+    owners = [c for c, mems in sorted(idx.classes.items())
+              if a in mems and mems[a].kind == "mutex"]
+    if len(owners) == 1:
+        return f"{owners[0]}::{a}"
+    return a
+
+
+def _guard_args(text: str, open_pos: int) -> list[str]:
+    """Top-level args of the guard constructor whose ( or { is at
+    open_pos."""
+    close = open_pos
+    depth = 0
+    pairs = {"(": ")", "{": "}"}
+    opener = text[open_pos]
+    closer = pairs[opener]
+    for i in range(open_pos, len(text)):
+        if text[i] == opener:
+            depth += 1
+        elif text[i] == closer:
+            depth -= 1
+            if depth == 0:
+                close = i
+                break
+    return _split_commas(text[open_pos + 1:close])
+
+
+def _compute_guards(idx: ConcurrencyIndex, fn: CFunction) -> None:
+    for i, st in enumerate(fn.statements):
+        for gm in _GUARD_RE.finditer(st.text):
+            args = _guard_args(st.text, gm.end() - 1)
+            if any("defer_lock" in a or "try_to_lock" in a for a in args):
+                continue
+            locks = [a for a in args
+                     if "adopt_lock" not in a and not a.isdigit()]
+            for arg in locks:
+                fn.guards.append(
+                    (i, _canon_lock(idx, fn, arg), st.line))
+    held = []
+    for j, st in enumerate(fn.statements):
+        cur = set()
+        for i, lock, _line in fn.guards:
+            if j <= i:
+                continue
+            gb = fn.statements[i].blocks
+            if st.blocks[:len(gb)] == gb:
+                cur.add(lock)
+        held.append(frozenset(cur))
+    fn.held = held
+
+
+# ------------------------------------------------------------- accesses
+
+_QUAL_ACCESS_RE = re.compile(
+    r"(\w+)(\[[^\]]*\])?\s*(?:->|\.)\s*([A-Za-z_]\w*)\b")
+_BARE_ACCESS_RE = re.compile(r"(?<![\w.>])([A-Za-z_]\w*)\b")
+_ASSIGN_RE = re.compile(r"^(?:\+|-|\*|/|%|&&?|\|\|?|\^|<<|>>)?=(?!=)")
+
+
+def _skip_subscripts(text: str, pos: int) -> int:
+    while pos < len(text):
+        rest = text[pos:]
+        ws = len(rest) - len(rest.lstrip())
+        if pos + ws < len(text) and text[pos + ws] == "[":
+            depth = 0
+            i = pos + ws
+            while i < len(text):
+                if text[i] == "[":
+                    depth += 1
+                elif text[i] == "]":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                i += 1
+            pos = i + 1
+        else:
+            return pos
+    return pos
+
+
+def _is_write_at(text: str, start: int, end: int) -> bool:
+    after = text[_skip_subscripts(text, end):].lstrip()
+    if after.startswith("++") or after.startswith("--"):
+        return True
+    if _ASSIGN_RE.match(after):
+        return True
+    if _MUTATOR_RE.match(after):
+        return True
+    before = text[:start].rstrip()
+    if before.endswith("++") or before.endswith("--"):
+        return True
+    if re.search(r"\.\s*swap\s*\(\s*&?$", before):
+        return True
+    if re.search(r"\b(?:memset|memcpy|bzero)\s*\(\s*&?\s*$", before):
+        return True
+    return False
+
+
+def _member_lookup(idx: ConcurrencyIndex, fn: CFunction, recv: str | None,
+                  name: str) -> CMember | None:
+    """Resolve an access with no speculation: typed receiver first,
+    then enclosing class (bare, unshadowed), then a globally unique
+    member name."""
+    if recv is not None:
+        tcls = _receiver_type(idx, fn, recv)
+        if tcls:
+            return idx.classes.get(tcls, {}).get(name)
+        owners = [c for c, mems in sorted(idx.classes.items())
+                  if name in mems]
+        if len(owners) == 1:
+            return idx.classes[owners[0]][name]
+        return None
+    if name in fn.locals:
+        return None
+    if fn.cls and name in idx.classes.get(fn.cls, {}):
+        return idx.classes[fn.cls][name]
+    return None
+
+
+def _compute_accesses(idx: ConcurrencyIndex, fn: CFunction) -> None:
+    for j, st in enumerate(fn.statements):
+        t = st.text
+        seen_spans: list[tuple[int, int]] = []
+        # atomic member operations first (they look like method calls)
+        for m in _ATOMIC_OP_RE.finditer(t):
+            recv, name, op = m.group(1), m.group(2), m.group(3)
+            if recv in fn.owned:
+                continue  # touch through an owned local: pre-escape
+            mem = _member_lookup(idx, fn, recv, name) if recv else \
+                _member_lookup(idx, fn, None, name)
+            if mem is None or mem.kind != "atomic":
+                continue
+            seen_spans.append((m.start(), m.end()))
+            fn.accesses.append(Access(
+                mem.cls, mem.name, op != "load", fn.rel, st.line,
+                fn.held[j], fn.qname, atomic=True, op=op))
+        covered = list(seen_spans)
+        for m in _QUAL_ACCESS_RE.finditer(t):
+            if re.match(r"\s*\(", t[m.end():]):
+                continue  # method call — the call graph's business
+            if any(s <= m.start() < e for s, e in covered):
+                continue
+            if m.group(1) in fn.owned:
+                continue  # write through an owned local: pre-escape
+            mem = _member_lookup(idx, fn, m.group(1), m.group(3))
+            if mem is None or mem.kind in ("mutex", "cv", "thread"):
+                continue
+            covered.append((m.start(), m.end()))
+            fn.accesses.append(Access(
+                mem.cls, mem.name,
+                _is_write_at(t, m.start(), m.end()), fn.rel, st.line,
+                fn.held[j], fn.qname, atomic=(mem.kind == "atomic")))
+        for m in _BARE_ACCESS_RE.finditer(t):
+            if any(s <= m.start() < e for s, e in covered):
+                continue
+            if re.match(r"\s*\(", t[m.end():]):
+                continue
+            name = m.group(1)
+            if name in _CALL_KEYWORDS or name in _KEYWORDS:
+                continue
+            mem = _member_lookup(idx, fn, None, name)
+            if mem is None or mem.kind in ("mutex", "cv", "thread"):
+                continue
+            fn.accesses.append(Access(
+                mem.cls, mem.name,
+                _is_write_at(t, m.start(), m.end()), fn.rel, st.line,
+                fn.held[j], fn.qname, atomic=(mem.kind == "atomic")))
+
+
+# ------------------------------------------------------------ call graph
+
+_CALL_RE = re.compile(
+    r"(?:(\w+)(?:\[[^\]]*\])?\s*(->|\.)\s*)?([A-Za-z_]\w*)\s*\(")
+_NEW_RE = re.compile(r"\bnew\s+([A-Z]\w*)\s*[\(\{]")
+_DELETE_RE = re.compile(r"\bdelete\s+(?:\[\]\s*)?(\w+)\b")
+_DECL_CTOR_RE = re.compile(r"\b([A-Z]\w*)\s+(\w+)\s*\(")
+
+
+def _resolve_call(idx: ConcurrencyIndex, fn: CFunction, recv: str | None,
+                  name: str) -> str | None:
+    if name in _CALL_KEYWORDS:
+        return None
+    if recv is None or recv == "this":
+        lam = f"{fn.qname}::{name}"
+        if lam in idx.functions:
+            return lam
+        if fn.parent:
+            plam = f"{fn.parent}::{name}"
+            if plam in idx.functions:
+                return plam
+        if fn.cls and f"{fn.cls}::{name}" in idx.functions:
+            return f"{fn.cls}::{name}"
+        cands = idx.by_short.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+    tcls = _receiver_type(idx, fn, recv)
+    if tcls and f"{tcls}::{name}" in idx.functions:
+        return f"{tcls}::{name}"
+    # an unknown receiver type gets NO fallback: `fd_cache_.begin()`
+    # must not resolve to Store::begin just because the short name is
+    # unique in the tree
+    return None
+
+
+def _compute_calls(idx: ConcurrencyIndex, fn: CFunction) -> None:
+    for j, st in enumerate(fn.statements):
+        t = st.text
+        for m in _CALL_RE.finditer(t):
+            callee = _resolve_call(idx, fn, m.group(1), m.group(3))
+            if callee:
+                fn.calls.append((callee, st.line, fn.held[j]))
+        for m in _NEW_RE.finditer(t):
+            ctor = f"{m.group(1)}::{m.group(1)}"
+            if ctor in idx.functions:
+                fn.calls.append((ctor, st.line, fn.held[j]))
+        for m in _DELETE_RE.finditer(t):
+            tcls = _receiver_type(idx, fn, m.group(1)) or \
+                fn.local_types.get(m.group(1))
+            if tcls:
+                dtor = f"{tcls}::~{tcls}"
+                if dtor in idx.functions:
+                    fn.calls.append((dtor, st.line, fn.held[j]))
+        for m in _DECL_CTOR_RE.finditer(t):
+            if m.group(1) in idx.classes and \
+                    f"{m.group(1)}::{m.group(1)}" in idx.functions:
+                fn.local_types.setdefault(m.group(2), m.group(1))
+                fn.calls.append((f"{m.group(1)}::{m.group(1)}",
+                                 st.line, fn.held[j]))
+
+
+# ----------------------------------------------------------------- roots
+
+_SPAWN_HINT_RE = re.compile(
+    r"std::thread\b|\.\s*(?:emplace_back|push_back)\s*\(")
+_THREAD_ASSIGN_RE = re.compile(r"\b(\w+_?)\s*=\s*std::thread")
+_LOOP_HEAD_RE = re.compile(r"^\s*(?:for|while)\s*\(")
+
+
+def _is_lifecycle(fn: CFunction) -> bool:
+    for st in fn.statements:
+        if "std::thread" in st.text or re.search(
+                r"\.\s*join\s*\(|\bpthread_join\s*\(", st.text):
+            return True
+    return False
+
+
+def _spawn_target(idx: ConcurrencyIndex, fn: CFunction,
+                  st: CStmt, lambdas_by_start: dict) -> str | None:
+    """The synthetic lambda function spawned by this statement, if
+    any — or a named entry from `std::thread(&Cls::fn, …)`."""
+    for off, lam_q in lambdas_by_start.items():
+        if st.span[0] <= off < st.span[1]:
+            lam = idx.functions.get(lam_q)
+            if lam is not None and lam.parent == fn.qname:
+                return lam_q
+    m = re.search(r"std::thread\s*\(\s*&?([A-Za-z_][\w:]*)", st.text)
+    if m:
+        name = m.group(1)
+        if name in idx.functions:
+            return name
+        short = name.rsplit("::", 1)[-1]
+        cands = idx.by_short.get(short, [])
+        if len(cands) == 1:
+            return cands[0]
+    return None
+
+
+def _root_label(idx: ConcurrencyIndex, fn: CFunction, st: CStmt,
+                entry: str) -> str:
+    lam = idx.functions.get(entry)
+    if lam is not None and lam.is_lambda:
+        body_calls = [c for c, _l, _h in lam.calls]
+        stmts = [s for s in lam.statements if s.text]
+        if len(stmts) == 1 and len(body_calls) == 1:
+            return body_calls[0].rsplit("::", 1)[-1]
+    m = _THREAD_ASSIGN_RE.search(st.text)
+    if m:
+        return m.group(1).rstrip("_")
+    return entry.rsplit("::", 1)[-1]
+
+
+def _compute_roots(idx: ConcurrencyIndex, lambdas_by_start: dict) -> None:
+    spawn_counts: dict[str, int] = {}
+    spawns: list[tuple[CFunction, CStmt, str]] = []
+    for q in sorted(idx.functions):
+        fn = idx.functions[q]
+        for st in fn.statements:
+            if not _SPAWN_HINT_RE.search(st.text):
+                continue
+            target = _spawn_target(idx, fn, st, lambdas_by_start)
+            if target is None:
+                continue
+            is_thread = "std::thread" in st.text
+            if not is_thread:
+                # …emplace_back(<lambda>) only spawns when the receiver
+                # is a thread container
+                rm = re.search(
+                    r"(\w+)\s*\.\s*(?:emplace_back|push_back)\s*\(",
+                    st.text)
+                mem = _member_lookup(idx, fn, None, rm.group(1)) \
+                    if rm else None
+                if mem is None or mem.kind != "thread":
+                    continue
+            spawn_counts[target] = spawn_counts.get(target, 0) + 1
+            spawns.append((fn, st, target))
+    for fn, st, target in spawns:
+        in_loop = bool(_LOOP_HEAD_RE.match(st.text))
+        for bid in st.blocks:
+            head = fn.block_heads.get(bid, "")
+            if re.search(r"\b(?:for|while)\s*\(", head):
+                in_loop = True
+        multi = in_loop or spawn_counts[target] > 1
+        label = _root_label(idx, fn, st, target)
+        prev = idx.roots.get(target)
+        if prev is None:
+            idx.roots[target] = Root(target, label, multi)
+        elif multi:
+            prev.multi = True
+    api_entries = [q for q in sorted(idx.functions)
+                   if idx.functions[q].api]
+    for q in api_entries:
+        idx.roots.setdefault(q, Root(q, "api", True))
+
+    # closure with the lifecycle cut: start()/stop() run single-threaded
+    # around spawn/join, so roots neither land on nor flow through them
+    for key in sorted(idx.roots):
+        seen: set[str] = set()
+        frontier = [key]
+        while frontier:
+            q = frontier.pop()
+            if q in seen:
+                continue
+            fn = idx.functions.get(q)
+            if fn is None or fn.lifecycle:
+                continue
+            seen.add(q)
+            idx.fn_roots.setdefault(q, set()).add(key)
+            for callee, _line, _held in fn.calls:
+                frontier.append(callee)
+
+    for key in sorted(idx.roots):
+        for q, rts in idx.fn_roots.items():
+            if key not in rts:
+                continue
+            fn = idx.functions[q]
+            if any(re.search(r"\bepoll_wait\s*\(", st.text)
+                   for st in fn.statements):
+                idx.reactor_roots.add(key)
+                break
+
+
+def _compute_handoffs(idx: ConcurrencyIndex,
+                      inbox_members: set[tuple[str, str]]) -> None:
+    """Handoff functions: mutate an inbox member under a lock AND wake
+    the reactor (eventfd write / a wake-named callee) — the documented
+    inbox/eventfd edge."""
+    for q in sorted(idx.functions):
+        fn = idx.functions[q]
+        mutates = any((a.cls, a.member) in inbox_members and a.write
+                      and a.locks for a in fn.accesses)
+        if not mutates:
+            continue
+        wakes = any(re.search(
+            r"\bwake\w*\s*\(|\w*wake\s*\(|\beventfd_write\s*\(|"
+            r"notify_(?:one|all)\s*\(", st.text)
+            for st in fn.statements)
+        if not wakes:
+            wakes = any("wake" in c.rsplit("::", 1)[-1]
+                        for c, _l, _h in fn.calls)
+        if wakes:
+            idx.handoff_fns.add(q)
+
+
+# -------------------------------------------------------------- members
+
+
+def _class_spans(text: str) -> list[tuple[str, int, int]]:
+    spans = []
+    for m in _CLASS_RE.finditer(text):
+        lead = text[max(0, m.start() - 8):m.start()]
+        if lead.rstrip().endswith("enum"):
+            continue
+        ob = m.end() - 1
+        spans.append((m.group(2), ob, _balanced(text, ob)))
+    return spans
+
+
+_ACCESS_LABEL_RE = re.compile(r"\b(?:public|private|protected)\s*:")
+_RANKED_RE = re.compile(
+    r"\b(?:dm::)?(?:Ordered)?Mutex\s+(\w+)\s*\{\s*(kRank\w+)")
+_DM_RANKED_RE = re.compile(r"\bDM_RANKED\s*\(\s*(\w+)\s*,\s*(kRank\w+)")
+_PLAIN_MUTEX_RE = re.compile(
+    r"\b(?:(?:dm::)?(?:Ordered)?Mutex|std::(?:recursive_|shared_|timed_)?"
+    r"mutex|pthread_mutex_t)\s+(\w+)")
+_CV_RE = re.compile(r"\bstd::condition_variable(?:_any)?\s+(\w+)")
+_THREAD_RE = re.compile(
+    r"\bstd::(?:vector\s*<\s*std::)?(?:thread|jthread)\s*>?\s+(\w+)")
+
+
+def _blank_regions(text: str, opens: str, closes: str) -> str:
+    out = list(text)
+    depth = 0
+    for i, c in enumerate(text):
+        if c in opens:
+            depth += 1
+            out[i] = " "
+        elif c in closes:
+            depth -= 1
+            out[i] = " "
+        elif depth > 0:
+            out[i] = " "
+    return "".join(out)
+
+
+def _parse_member_decl(idx: ConcurrencyIndex, cls: str, decl: str,
+                       rel: str, line: int) -> None:
+    d = _ACCESS_LABEL_RE.sub(" ", decl).strip()
+    if not d or d.startswith(("using ", "typedef ", "friend ",
+                              "static_assert", "template")):
+        return
+    members = idx.classes.setdefault(cls, {})
+
+    m = _RANKED_RE.search(d) or _DM_RANKED_RE.search(d)
+    if m:
+        members[m.group(1)] = CMember(cls, m.group(1), "mutex",
+                                      m.group(2), rel, line, d[:60])
+        return
+    m = _CV_RE.search(d)
+    if m:
+        members[m.group(1)] = CMember(cls, m.group(1), "cv", None, rel,
+                                      line, d[:60])
+        return
+    m = _THREAD_RE.search(d)
+    if m:
+        members[m.group(1)] = CMember(cls, m.group(1), "thread", None,
+                                      rel, line, d[:60])
+        return
+    m = _PLAIN_MUTEX_RE.search(d)
+    if m:
+        members[m.group(1)] = CMember(cls, m.group(1), "mutex", None,
+                                      rel, line, d[:60])
+        return
+    if "std::atomic" in d:
+        pos = d.find("std::atomic")
+        i = d.find("<", pos)
+        if i > 0:
+            depth = 0
+            while i < len(d):
+                if d[i] == "<":
+                    depth += 1
+                elif d[i] == ">":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                i += 1
+            tail = d[i + 1:]
+            for part in _split_commas(_blank_regions(tail, "{", "}")):
+                nm = re.match(r"([A-Za-z_]\w*)", part.strip())
+                if nm:
+                    members[nm.group(1)] = CMember(
+                        cls, nm.group(1), "atomic", None, rel, line,
+                        d[:60])
+        return
+    # plain data members: blank templates/initializers, then the last
+    # identifier of each comma declarator is the name
+    flat = _blank_regions(d, "<", ">")
+    flat = _blank_regions(flat, "{", "}")
+    flat = re.sub(r"\[[^\]]*\]", " ", flat)
+    if "(" in flat:
+        return  # member function declaration / function pointer
+    parts = _split_commas(flat)
+    if not parts:
+        return
+    first = parts[0]
+    nm = re.search(r"([A-Za-z_]\w*)\s*(?:=[^,]*)?$", first)
+    if not nm:
+        return
+    name = nm.group(1)
+    type_text = first[:nm.start()].strip()
+    toks = re.findall(r"[A-Za-z_][\w:]*", type_text)
+    if not toks or name in _CALL_KEYWORDS or \
+            type_text.rstrip().endswith(("return", "goto")):
+        return
+    members[name] = CMember(cls, name, "data", None, rel, line,
+                            type_text[:60])
+    for part in parts[1:]:
+        nm = re.search(r"([A-Za-z_]\w*)\s*(?:=[^,]*)?$", part)
+        if nm:
+            members[nm.group(1)] = CMember(cls, nm.group(1), "data",
+                                           None, rel, line,
+                                           type_text[:60])
+
+
+def _members_of(idx: ConcurrencyIndex, cls: str, text: str, start: int,
+                end: int, rel: str,
+                inner_spans: list[tuple[str, int, int]]) -> None:
+    i = start
+    buf_start = start
+    while i < end:
+        c = text[i]
+        if c == ";":
+            decl = text[buf_start:i]
+            ds = buf_start + (len(decl) - len(decl.lstrip()))
+            _parse_member_decl(idx, cls, decl.strip(), rel,
+                               _line_of(text, ds))
+            buf_start = i + 1
+            i += 1
+        elif c == "{":
+            chunk = text[buf_start:i]
+            if re.search(r"\)\s*(?:const\b|noexcept\b|override\b|"
+                         r"final\b|\s|->\s*[\w:<>&*\s]+?)*$", chunk) or \
+                    _INIT_LIST_RE.search(chunk) or \
+                    re.search(r"\b(?:class|struct|enum|union)\b", chunk):
+                i = _balanced(text, i)
+                buf_start = i
+            else:
+                i = _balanced(text, i)  # brace initializer: keep in buf
+        else:
+            i += 1
+
+
+# ----------------------------------------------------------- statements
+
+
+_INLINE_COND_RE = re.compile(r"\b(?:if|while)\s*\((.*)\)", re.DOTALL)
+
+
+def _split_statements(fn: CFunction, body: str, base: int, text: str,
+                      counter: list) -> None:
+    stack: list[int] = []
+    cond_stack: list[str] = []
+    buf_start = 0
+    paren = 0
+    n = len(body)
+
+    def emit(upto: int) -> None:
+        chunk = body[buf_start:upto]
+        stripped = chunk.strip()
+        if not stripped:
+            return
+        start = buf_start + (len(chunk) - len(chunk.lstrip()))
+        st = CStmt(_line_of(text, base + start), stripped, tuple(stack),
+                   [c for c in cond_stack if c],
+                   (base + start, base + upto))
+        im = _INLINE_COND_RE.search(stripped)
+        if im and not stripped.rstrip().endswith(")"):
+            st.conds.append(im.group(1))
+        fn.statements.append(st)
+
+    i = 0
+    while i < n:
+        c = body[i]
+        if c == "(":
+            paren += 1
+        elif c == ")":
+            paren = max(0, paren - 1)
+        elif c == ";" and paren == 0:
+            emit(i)
+            buf_start = i + 1
+        elif c == "{":
+            head = body[buf_start:i].strip()
+            emit(i)
+            counter[0] += 1
+            bid = counter[0]
+            cm = re.search(r"\b(?:if|while|for|switch)\s*\((.*)\)\s*$",
+                           head, re.DOTALL)
+            cond_stack.append(cm.group(1) if cm else "")
+            stack.append(bid)
+            fn.block_heads[bid] = head
+            buf_start = i + 1
+            paren = 0
+        elif c == "}":
+            emit(i)
+            if stack:
+                stack.pop()
+                cond_stack.pop()
+            buf_start = i + 1
+            paren = 0
+        i += 1
+    emit(n)
+
+
+def _carve_lambdas(idx: ConcurrencyIndex, parent: CFunction, body: str,
+                   base: int, text: str, counter: list,
+                   lambdas_by_start: dict) -> str:
+    pos = 0
+    while True:
+        m = _LAMBDA_RE.search(body, pos)
+        if not m:
+            return body
+        ob = m.end() - 1
+        end = _balanced(body, ob)
+        name_m = re.search(r"(?:auto|const\s+auto)\s*&?\s*(\w+)\s*=\s*$",
+                           body[:m.start()])
+        idx._lambda_seq += 1
+        line = _line_of(text, base + m.start())
+        short = name_m.group(1) if name_m else f"lambda@{line}"
+        qname = f"{parent.qname}::{short}"
+        if qname in idx.functions:
+            qname = f"{parent.qname}::{short}#{idx._lambda_seq}"
+        lam = CFunction(qname, parent.cls, short, parent.rel, line,
+                        base + m.start(), base + end,
+                        "(" + (m.group(1) or "") + ")",
+                        is_lambda=True, parent=parent.qname)
+        inner = body[ob + 1:end - 1]
+        inner = _carve_lambdas(idx, lam, inner, base + ob + 1, text,
+                               counter, lambdas_by_start)
+        _split_statements(lam, inner, base + ob + 1, text, counter)
+        idx.functions[qname] = lam
+        idx.by_short.setdefault(short, []).append(qname)
+        lambdas_by_start[base + m.start()] = qname
+        blanked = re.sub(r"[^\n]", " ", body[m.start():end])
+        body = body[:m.start()] + blanked + body[end:]
+        pos = end
+
+
+# --------------------------------------------------------------- driver
+
+
+def native_files(native_dir: Path) -> list[Path]:
+    return sorted(native_dir.glob("*.h")) + sorted(native_dir.glob("*.cc"))
+
+
+def discover_native_files(files) -> list[Path]:
+    """cache_extra_inputs body shared by the three passes: the native
+    sources whose stat triples join each rule's cache key. Discovery
+    mirrors the passes' anchoring — the real tree via
+    ``demodel_tpu/utils/env.py``, fixtures via the
+    ``concurrency-native=`` pragma."""
+    dirs: list[Path] = []
+    for p in files:
+        path = Path(p)
+        posix = path.as_posix()
+        if posix.endswith("demodel_tpu/utils/env.py"):
+            root = Path(posix[: -len("demodel_tpu/utils/env.py")] or ".")
+            dirs.append(root / "native")
+            continue
+        try:
+            head = path.read_text(encoding="utf-8", errors="replace")[:4096]
+        except OSError:
+            continue
+        pm = PRAGMA_RE.search(head)
+        if pm:
+            dirs.append(path.parent / pm.group(1))
+    out: list[Path] = []
+    for d in dirs:
+        if d.is_dir():
+            out.extend(native_files(d))
+    return out
+
+
+_INDEX_CACHE: dict[tuple, ConcurrencyIndex] = {}
+
+
+def build_index(native_dir: Path, prefix: str) -> ConcurrencyIndex | None:
+    """Build (or fetch the memoized) concurrency index for one native
+    dir. Returns None when the dir has no indexable sources."""
+    paths = [p for p in native_files(native_dir)
+             if p.name not in EXCLUDED_FILES]
+    rank_paths = native_files(native_dir)
+    sig = tuple((p.name, p.stat().st_mtime_ns, p.stat().st_size)
+                for p in rank_paths)
+    key = (str(native_dir.resolve()), prefix, sig)
+    if key in _INDEX_CACHE:
+        return _INDEX_CACHE[key]
+    if not paths:
+        return None
+
+    idx = ConcurrencyIndex()
+    texts: list[tuple[str, str]] = []   # (rel, stripped text)
+    all_texts: list[str] = []           # rank-usage census, every file
+    api_spans: dict[str, list] = {}
+    file_class_spans: dict[str, list] = {}
+    counter = [0]
+
+    # ranks come from EVERY file (lock_order.h included)
+    for p in rank_paths:
+        try:
+            raw = p.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            continue
+        text = _strip_pp(strip_code(raw))
+        rel = f"{prefix}{p.name}"
+        for m in RANK_RE.finditer(text):
+            idx.ranks.setdefault(
+                m.group(1), (int(m.group(2)), rel, _line_of(text, m.start())))
+        if p.name not in EXCLUDED_FILES:
+            texts.append((rel, text))
+        all_texts.append(text)
+    for name in idx.ranks:
+        uses = sum(len(re.findall(r"\b%s\b" % re.escape(name), t))
+                   for t in all_texts)
+        idx.rank_uses[name] = uses - 1  # minus the definition itself
+
+    # pass 1: classes and members
+    for rel, text in texts:
+        spans = _class_spans(text)
+        file_class_spans[rel] = spans
+        ex = []
+        for m in re.finditer(r'extern\s*"[^"\n]*"\s*\{', text):
+            ob = m.end() - 1
+            ex.append((ob, _balanced(text, ob)))
+        api_spans[rel] = ex
+        for cls, ob, end in spans:
+            inner = [s for s in spans if s[1] > ob and s[2] <= end]
+            _members_of(idx, cls, text, ob + 1, end - 1, rel, inner)
+
+    # pointer/reference member types (Store *store_ → Store)
+    for cls, mems in idx.classes.items():
+        for name, mem in mems.items():
+            tm = re.match(r"(?:const\s+)?([A-Z]\w*)\s*[*&]", mem.type_text)
+            if tm and tm.group(1) in idx.classes:
+                idx.member_types[(cls, name)] = tm.group(1)
+
+    # pass 2: functions (+ carved lambdas)
+    lambdas_by_start: dict[str, dict] = {}
+    for rel, text in texts:
+        lambdas_by_start[rel] = {}
+        spans = file_class_spans[rel]
+        pos = 0
+        while True:
+            fm = _FN_OPEN_RE.search(text, pos)
+            if not fm:
+                break
+            ob = fm.end() - 1
+            close = text.rfind(")", fm.start(), ob + 1)
+            ilm = _INIT_LIST_RE.search(text[max(0, ob - 2000):ob])
+            if ilm:
+                close = max(0, ob - 2000) + ilm.start()
+            name = _match_name(text, close)
+            if not name or name.rsplit("::", 1)[-1] in _CALL_KEYWORDS:
+                pos = fm.end()
+                continue
+            # inline destructors: _match_name drops the leading ~
+            nstart = text.rfind(name, 0, close)
+            if nstart > 0 and text[nstart - 1] == "~" \
+                    and "~" not in name:
+                name = "~" + name
+            enclosing = None
+            for cls, cob, cend in spans:
+                if cob < fm.start() < cend:
+                    if enclosing is None or cob > enclosing[1]:
+                        enclosing = (cls, cob)
+            if "::" in name.replace("::~", "~"):
+                qname = name
+                cls: str | None = name.rsplit("::", 1)[0]
+            elif enclosing:
+                cls = enclosing[0]
+                qname = f"{cls}::{name}"
+            else:
+                cls = None
+                qname = name
+            end = _balanced(text, ob)
+            if qname in idx.functions:
+                qname = f"{qname}#{_line_of(text, fm.start())}"
+            hstart = text.rfind("\n", 0, nstart) + 1
+            fn = CFunction(qname, cls, name.rsplit("::", 1)[-1], rel,
+                           _line_of(text, fm.start()), fm.start(), end,
+                           text[hstart:ob])
+            fn.api = any(s <= fm.start() < e for s, e in api_spans[rel])
+            body = text[ob + 1:end - 1]
+            body = _carve_lambdas(idx, fn, body, ob + 1, text, counter,
+                                  lambdas_by_start[rel])
+            _split_statements(fn, body, ob + 1, text, counter)
+            idx.functions[qname] = fn
+            idx.by_short.setdefault(fn.short, []).append(qname)
+            pos = end
+
+    # pass 3: per-function analysis
+    for q in sorted(idx.functions):
+        fn = idx.functions[q]
+        fn.lifecycle = _is_lifecycle(fn)
+        _collect_locals(idx, fn)
+    for q in sorted(idx.functions):
+        fn = idx.functions[q]
+        _compute_guards(idx, fn)
+    for q in sorted(idx.functions):
+        fn = idx.functions[q]
+        _compute_calls(idx, fn)
+        _compute_accesses(idx, fn)
+    for q in sorted(idx.functions):
+        for callee, _line, held in idx.functions[q].calls:
+            idx.callers.setdefault(callee, []).append((q, held))
+
+    merged_lambda_starts: dict = {}
+    for rel in lambdas_by_start:
+        merged_lambda_starts.update(lambdas_by_start[rel])
+    _compute_roots(idx, merged_lambda_starts)
+
+    # inbox detection: a member the reactor closure drains via swap
+    inbox_members: set[tuple[str, str]] = set()
+    for root in sorted(idx.reactor_roots):
+        for q, rts in sorted(idx.fn_roots.items()):
+            if root not in rts:
+                continue
+            fn = idx.functions[q]
+            for st in fn.statements:
+                for sm in re.finditer(
+                        r"\b\w+\s*\.\s*swap\s*\(\s*(\w+)\s*\)|"
+                        r"\b(\w+)\s*\.\s*swap\s*\(", st.text):
+                    name = sm.group(1) or sm.group(2)
+                    mem = _member_lookup(idx, fn, None, name)
+                    if mem is not None and mem.kind == "data":
+                        inbox_members.add((mem.cls, mem.name))
+    idx.inbox_members = inbox_members
+    _compute_handoffs(idx, inbox_members)
+
+    _INDEX_CACHE[key] = idx
+    if len(_INDEX_CACHE) > 8:
+        _INDEX_CACHE.pop(next(iter(_INDEX_CACHE)))
+    return idx
+
+
+def fmt_locks(locks: frozenset) -> str:
+    if not locks:
+        return "NO lock"
+    return "{" + ", ".join(sorted(locks)) + "}"
+
+
+class NativeAnchorMixin:
+    """Shared anchoring for the three concurrency passes: the real tree
+    activates via ``demodel_tpu/utils/env.py`` → ``<root>/native``;
+    fixtures via a ``# demodel: concurrency-native=<dir>`` pragma."""
+
+    @classmethod
+    def cache_extra_inputs(cls, files) -> list:
+        return discover_native_files(files)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._native_dirs: list[tuple[Path, str]] = []
+
+    def visit(self, ctx):
+        pm = PRAGMA_RE.search(ctx.source)
+        if pm:
+            self._native_dirs.append(
+                (Path(ctx.path).resolve().parent / pm.group(1),
+                 ctx.rel.rsplit("/", 1)[0] + "/" + pm.group(1) + "/"
+                 if "/" in ctx.rel else pm.group(1) + "/"))
+        elif ctx.rel == "demodel_tpu/utils/env.py":
+            root = Path(str(Path(ctx.path).resolve())[: -len(ctx.rel)]) \
+                if str(Path(ctx.path).resolve()).endswith(ctx.rel) \
+                else Path.cwd()
+            self._native_dirs.append((root / "native", "native/"))
+        return iter(())
+
+    def each_index(self):
+        seen: set[Path] = set()
+        for native_dir, prefix in self._native_dirs:
+            if native_dir in seen or not native_dir.is_dir():
+                continue
+            seen.add(native_dir)
+            idx = build_index(native_dir, prefix)
+            if idx is not None:
+                yield idx

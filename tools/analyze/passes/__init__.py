@@ -14,7 +14,10 @@ from tools.analyze.passes import (  # noqa: F401 — registration imports
     lock_order,
     log_hygiene,
     metric_hygiene,
+    native_guarded_field,
+    native_lock_order,
     obligation_leak,
+    reactor_ownership,
     surface_parity,
     swarm_policy,
     threads,

@@ -1,0 +1,175 @@
+"""native-guarded-field: lock-set races over the native concurrency index.
+
+The RacerD shape, ported to the clang-free native plane
+(:mod:`tools.analyze.native_concurrency`): every read/write of a data
+member in a class that owns a mutex or atomic is summarized with the
+lock set held at the site — lexical ``lock_guard``/``unique_lock``/
+``scoped_lock`` regions plus the caller-held intersection composed
+through the C++ call graph at bounded depth — and with the thread
+roots that can reach it (worker pool, reactor loop, accept loop,
+sampler, the ``extern "C"`` API surface). A member written on one root
+and touched on another with DISJOINT lock sets is a race finding
+blaming both sites and both roots. One root races itself only when it
+is multi-instance (the worker pool, API callers).
+
+Relaxed-atomic members get the ``atomic-check-then-act`` sub-check: a
+branch that tests an atomic and a plain store that rewrites it under
+that branch (outside any ``compare_exchange`` discipline) is a lost
+update waiting for an interleave.
+
+Silent by construction: members of classes with no synchronization
+members at all (the lock-free handoff plane — Session, WriteState —
+is reactor-ownership's jurisdiction), accesses in lifecycle functions
+(single-threaded around spawn/join), constructors/destructors touching
+their OWN class (owned-before-shared), and any site no thread root
+reaches — no speculative roots, no speculative edges.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterator
+
+from tools.analyze.core import Finding, Pass, register
+from tools.analyze.native_concurrency import (
+    ConcurrencyIndex,
+    NativeAnchorMixin,
+    fmt_locks,
+)
+
+
+@register
+class NativeGuardedFieldPass(NativeAnchorMixin, Pass):
+    id = "native-guarded-field"
+    version = "1"
+    description = (
+        "native lock-set races: a C++ class member written on one thread "
+        "root and touched on another with disjoint lock sets (lexical "
+        "guard regions + caller-held composition through the call "
+        "graph), blaming both sites and both roots; plus the "
+        "atomic-check-then-act sub-check on relaxed atomics"
+    )
+
+    def finalize(self) -> Iterator[Finding]:
+        for idx in self.each_index():
+            yield from self._races(idx)
+            yield from self._check_then_act(idx)
+
+    # ------------------------------------------------------------- races
+    def _sites(self, idx: ConcurrencyIndex) -> dict:
+        """(cls, member) → [(access, eff locks, roots)] for in-scope
+        data members."""
+        scoped = {
+            cls for cls, mems in idx.classes.items()
+            if any(m.kind in ("mutex", "atomic") for m in mems.values())
+        }
+        out: dict = {}
+        for q in sorted(idx.functions):
+            fn = idx.functions[q]
+            roots = idx.roots_of(q)
+            if not roots:
+                continue
+            for a in fn.accesses:
+                if a.atomic or a.cls not in scoped:
+                    continue
+                if fn.cls == a.cls and fn.short in (a.cls, f"~{a.cls}"):
+                    continue  # ctor/dtor of its own class: owned
+                out.setdefault((a.cls, a.member), []).append(
+                    (a, idx.eff_locks(a), roots))
+        return out
+
+    def _races(self, idx: ConcurrencyIndex) -> Iterator[Finding]:
+        for (cls, member), sites in sorted(self._sites(idx).items()):
+            sites.sort(key=lambda s: (s[0].rel, s[0].line))
+            pair = self._racing_pair(idx, sites)
+            if pair is None:
+                continue
+            (w, lw, rw), (a, la, _ra), r1, r2 = pair
+            other = "written" if a.write else "read"
+            yield Finding(
+                w.rel, w.line, self.id,
+                f"native field '{member}' of {cls} written here on root "
+                f"'{idx.roots[r1].label}' under {fmt_locks(lw)} and "
+                f"{other} at {a.rel}:{a.line} on root "
+                f"'{idx.roots[r2].label}' under {fmt_locks(la)} — lock "
+                "sets are disjoint, so both threads can touch it "
+                "concurrently; guard both sites with one mutex or make "
+                "the member atomic",
+            )
+
+    def _racing_pair(self, idx: ConcurrencyIndex, sites: list):
+        for ws in sites:
+            if not ws[0].write:
+                continue
+            for as_ in sites:
+                if ws[1] & as_[1]:
+                    continue  # a common lock orders them
+                rr = self._concurrent(idx, ws[2], as_[2])
+                if rr is not None:
+                    return ws, as_, rr[0], rr[1]
+        return None
+
+    @staticmethod
+    def _concurrent(idx: ConcurrencyIndex, rw: set, ra: set):
+        for r1 in sorted(rw):
+            for r2 in sorted(ra):
+                if r1 != r2:
+                    return r1, r2
+                if idx.roots[r1].multi:
+                    return r1, r2
+        return None
+
+    # --------------------------------------------------- check-then-act
+    def _check_then_act(self, idx: ConcurrencyIndex) -> Iterator[Finding]:
+        # atomics whose touches span enough roots to interleave
+        root_span: dict = {}
+        for q in sorted(idx.functions):
+            roots = idx.roots_of(q)
+            for a in idx.functions[q].accesses:
+                if a.atomic:
+                    root_span.setdefault((a.cls, a.member),
+                                         set()).update(roots)
+        seen: set = set()
+        for q in sorted(idx.functions):
+            fn = idx.functions[q]
+            if fn.lifecycle:
+                continue
+            cas_members = {
+                (a.cls, a.member) for a in fn.accesses
+                if a.op.startswith("compare_exchange")
+            }
+            for a in fn.accesses:
+                if not (a.atomic and a.write):
+                    continue
+                if a.op.startswith(("fetch_", "exchange",
+                                    "compare_exchange")):
+                    continue
+                key = (a.cls, a.member)
+                if key in cas_members:
+                    continue
+                roots = root_span.get(key, set())
+                if len(roots) < 2 and not any(
+                        idx.roots[r].multi for r in roots):
+                    continue
+                st = next((s for s in fn.statements
+                           if s.line == a.line), None)
+                if st is None:
+                    continue
+                name_re = re.compile(r"\b%s\b" % re.escape(a.member))
+                if a.op == "" and not re.search(
+                        r"\b%s\s*=[^=]" % re.escape(a.member), st.text):
+                    continue  # ++/compound ops are atomic RMW
+                if not any(name_re.search(c) for c in st.conds):
+                    continue
+                site = (a.rel, a.line, a.member)
+                if site in seen:
+                    continue
+                seen.add(site)
+                yield Finding(
+                    a.rel, a.line, self.id,
+                    f"check-then-act on atomic '{a.member}' of {a.cls}: "
+                    "the guarding branch tests the atomic and this "
+                    "store rewrites it non-atomically — another thread "
+                    "can interleave between the load and the store; "
+                    "use compare_exchange or a fetch_* RMW",
+                )

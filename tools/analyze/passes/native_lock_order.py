@@ -1,0 +1,132 @@
+"""native-lock-order: the kRank table as a whole-program static gate.
+
+``native/lock_order.h``'s ranked-mutex shim (DM_LOCK_ORDER_CHECK)
+aborts at runtime when a thread acquires a lock whose rank is ≤ the
+highest rank it already holds — but only on interleavings the TSan
+selftests actually drive. This rule mirrors the same invariant
+statically over the concurrency index: every acquisition site's rank
+is resolved from the ``kRank*`` table, nested acquisitions are
+composed through the call graph at bounded depth, and any edge from a
+higher (or equal) rank to a lower one is a finding — no test needs to
+drive the path.
+
+Two shapes fire:
+
+- **inversion** — a ``lock_guard``/``unique_lock``/``scoped_lock``
+  acquiring rank ``m`` while a lock of rank ``h >= m`` is lexically or
+  caller-held; call-site edges blame the caller's acquisition site and
+  name the callee path that performs the nested acquisition.
+- **unranked member** — a ``std::mutex`` (or rank-capable wrapper with
+  no rank brace) declared as a class member: invisible to
+  DM_LOCK_ORDER_CHECK, so invisible to the dynamic gate too. Every
+  native mutex member must carry a ``kRank*`` or a suppression
+  explaining why it is out of the scheme.
+
+Unranked locks contribute no edges (no speculative ranks); unresolved
+calls contribute no nesting. The rule is purely structural — it does
+not need thread roots, so it also covers code only reachable from
+lifecycle functions.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.analyze.core import Finding, Pass, register
+from tools.analyze.native_concurrency import (
+    ConcurrencyIndex,
+    NativeAnchorMixin,
+)
+
+
+@register
+class NativeLockOrderPass(NativeAnchorMixin, Pass):
+    id = "native-lock-order"
+    version = "1"
+    description = (
+        "static lock-order gate over the native kRank table: an "
+        "acquisition of rank <= an already-held rank (lexically or "
+        "composed through the call graph) is an inversion, and a "
+        "std::mutex member with no rank wrapper is invisible to "
+        "DM_LOCK_ORDER_CHECK"
+    )
+
+    def finalize(self) -> Iterator[Finding]:
+        for idx in self.each_index():
+            yield from self._unranked_members(idx)
+            yield from self._inversions(idx)
+
+    def _unranked_members(self, idx: ConcurrencyIndex) -> Iterator[Finding]:
+        for cls in sorted(idx.classes):
+            for name, mem in sorted(idx.classes[cls].items()):
+                if mem.kind == "mutex" and mem.rank is None:
+                    yield Finding(
+                        mem.rel, mem.line, self.id,
+                        f"mutex member '{cls}::{name}' has no kRank "
+                        "wrapper — DM_LOCK_ORDER_CHECK and the static "
+                        "order gate cannot see it; declare it as "
+                        "Mutex with a kRank constant from "
+                        "lock_order.h",
+                    )
+
+    def _inversions(self, idx: ConcurrencyIndex) -> Iterator[Finding]:
+        seen: set = set()
+        for q in sorted(idx.functions):
+            fn = idx.functions[q]
+            caller_held = idx.must_hold(q)
+            # intra-function: a guard taken while earlier guards in
+            # scope (or caller-held locks) outrank it
+            for i, lock, line in fn.guards:
+                rm = idx.rank_of(lock)
+                if rm is None:
+                    continue
+                lex = fn.held[i] if i < len(fn.held) else frozenset()
+                for h in sorted(lex | caller_held):
+                    if h == lock:
+                        continue
+                    rh = idx.rank_of(h)
+                    if rh is None or rm > rh:
+                        continue
+                    key = (fn.rel, line, h, lock)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Finding(
+                        fn.rel, line, self.id,
+                        f"lock-order inversion: '{lock}' (rank {rm}) "
+                        f"acquired while holding '{h}' (rank {rh}) — "
+                        "ranks must strictly increase down an "
+                        "acquisition chain; DM_LOCK_ORDER_CHECK would "
+                        "abort here at runtime",
+                    )
+            # call-site composition: the callee (transitively) acquires
+            # a ranked lock while this site holds an equal-or-higher one
+            for j, (callee, line, held) in enumerate(fn.calls):
+                eff = held | caller_held
+                if not eff:
+                    continue
+                acquired = idx.acquired_within(callee)
+                for lock in sorted(acquired):
+                    rm = idx.rank_of(lock)
+                    if rm is None:
+                        continue
+                    for h in sorted(eff):
+                        if h == lock:
+                            continue
+                        rh = idx.rank_of(h)
+                        if rh is None or rm > rh:
+                            continue
+                        key = (fn.rel, line, h, lock)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        path = " -> ".join(
+                            (callee,) + acquired[lock])
+                        yield Finding(
+                            fn.rel, line, self.id,
+                            f"lock-order inversion: this call reaches "
+                            f"an acquisition of '{lock}' (rank {rm}) "
+                            f"via {path} while holding '{h}' (rank "
+                            f"{rh}) — ranks must strictly increase "
+                            "down an acquisition chain",
+                        )

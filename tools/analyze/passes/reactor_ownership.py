@@ -1,0 +1,147 @@
+"""reactor-ownership: the single-owner reactor discipline as a rule.
+
+The native serve plane's reactor owns its structures outright: the
+epoll interest set, parked-session bookkeeping, ``Session::disp_``
+transitions, ``WriteState`` fields, and splice pipe fds are touched by
+exactly one thread, so they need no locks — PROVIDED nothing else ever
+touches them. Workers hand sessions over through the documented
+inbox/eventfd edge (push under the inbox mutex, write the wake
+eventfd, reactor drains via ``swap``). That discipline was established
+by convention; this rule makes it checkable.
+
+The declared single-owner resource table:
+
+- **epoll set mutations** — every ``epoll_ctl`` call site must be on a
+  reactor root.
+- **reactor bookkeeping** — writes to ``parked_`` / ``epoll_armed``
+  members: reactor root only.
+- **inbox members** — members the reactor drains via ``swap``: written
+  elsewhere only inside a handoff function (mutation under a lock +
+  a wake); any other off-reactor write bypasses the handshake.
+- **owned serve state** — ``disp_`` and members of lock-free
+  ``*State`` classes (no mutex/atomic/cv member — WriteState,
+  TunnelState): written off-reactor only from roots that hold a
+  handoff edge (they may prepare a session BEFORE submitting it) or
+  in the owning class's own constructor/destructor.
+
+Reads stay silent (the racy-read half is native-guarded-field's
+business where locks exist; owned structures are advisory to
+observers). Sites no root reaches stay silent — the lifecycle cut
+already proves start()/stop() run single-threaded. Trees with no
+reactor root (no ``epoll_wait`` under any spawn) are out of scope.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterator
+
+from tools.analyze.core import Finding, Pass, register
+from tools.analyze.native_concurrency import (
+    ConcurrencyIndex,
+    NativeAnchorMixin,
+)
+
+#: member names that are reactor-thread-only bookkeeping wherever they
+#: appear in a native tree
+REACTOR_ONLY = ("parked_", "epoll_armed")
+
+#: member names that mark owned serve state on any class
+OWNED_MEMBERS = ("disp_",)
+
+_EPOLL_CTL_RE = re.compile(r"\bepoll_ctl\s*\(")
+
+
+@register
+class ReactorOwnershipPass(NativeAnchorMixin, Pass):
+    id = "reactor-ownership"
+    version = "1"
+    description = (
+        "single-owner reactor discipline over the native serve plane: "
+        "epoll set mutations, parked/armed bookkeeping, inbox members "
+        "and lock-free *State fields may be written only on the "
+        "reactor root or through the documented inbox/eventfd handoff "
+        "edge"
+    )
+
+    def finalize(self) -> Iterator[Finding]:
+        for idx in self.each_index():
+            if not idx.reactor_roots:
+                continue
+            yield from self._check(idx)
+
+    def _check(self, idx: ConcurrencyIndex) -> Iterator[Finding]:
+        owner_classes = {
+            cls for cls, mems in idx.classes.items()
+            if cls.endswith("State") and mems and not any(
+                m.kind in ("mutex", "atomic", "cv")
+                for m in mems.values())
+        }
+        handoff_roots: set[str] = set()
+        for q in idx.handoff_fns:
+            handoff_roots |= idx.roots_of(q)
+        seen: set = set()
+
+        def emit(rel, line, what, msg):
+            key = (rel, line, what)
+            if key in seen:
+                return None
+            seen.add(key)
+            return Finding(rel, line, self.id, msg)
+
+        for q in sorted(idx.functions):
+            fn = idx.functions[q]
+            roots = idx.roots_of(q)
+            off_reactor = sorted(roots - idx.reactor_roots)
+            if not off_reactor:
+                continue
+            r = idx.roots[off_reactor[0]].label
+
+            for st in fn.statements:
+                if _EPOLL_CTL_RE.search(st.text):
+                    f = emit(fn.rel, st.line, "epoll_ctl",
+                             "epoll set mutated here on root "
+                             f"'{r}' — the epoll interest set is "
+                             "reactor-owned; hand the session to the "
+                             "reactor through the inbox/eventfd "
+                             "handoff instead")
+                    if f:
+                        yield f
+
+            for a in fn.accesses:
+                if not a.write:
+                    continue
+                own_ctor = fn.cls == a.cls and \
+                    fn.short in (a.cls, f"~{a.cls}")
+                if own_ctor:
+                    continue
+                if a.member in REACTOR_ONLY:
+                    f = emit(a.rel, a.line, a.member,
+                             f"'{a.cls}::{a.member}' is "
+                             "reactor-thread-only bookkeeping but is "
+                             f"written here on root '{r}' — only the "
+                             "reactor loop may touch it")
+                    if f:
+                        yield f
+                elif (a.cls, a.member) in idx.inbox_members:
+                    if q in idx.handoff_fns:
+                        continue
+                    f = emit(a.rel, a.line, a.member,
+                             f"'{a.cls}::{a.member}' is the reactor "
+                             "inbox but is written here on root "
+                             f"'{r}' outside a handoff function — "
+                             "the only legal off-reactor mutation is "
+                             "push-under-lock followed by a wake")
+                    if f:
+                        yield f
+                elif a.member in OWNED_MEMBERS or a.cls in owner_classes:
+                    if roots & handoff_roots:
+                        continue  # may prepare state before submitting
+                    f = emit(a.rel, a.line, a.member,
+                             f"'{a.cls}::{a.member}' is single-owner "
+                             "serve state but is written here on root "
+                             f"'{r}', which never hands sessions to "
+                             "the reactor — touches must ride the "
+                             "inbox/eventfd handoff")
+                    if f:
+                        yield f

@@ -172,13 +172,16 @@ def extract_native(native_dir: Path, rel_prefix: str) -> NativeSurface:
 @register
 class SurfaceParityPass(Pass):
     id = "surface-parity"
-    version = "1"
+    version = "2"
     description = (
         "native↔Python surface drift: env knobs resolved with different "
         "defaults/types per plane (or twice per plane), native metric "
         "gauge/counter typing disagreeing with utils/metrics.PROXY_GAUGES, "
-        "hist families the telemetry window never serves, and "
-        "native/lock_order.h ranks diverging from the Python mirror"
+        "hist families the telemetry window never serves, "
+        "native/lock_order.h ranks diverging from the Python mirror, "
+        "native mutex members declared without a rank wrapper, and rank "
+        "constants no native code ever references (dead rank = drifted "
+        "table)"
     )
 
     @classmethod
@@ -311,6 +314,7 @@ class SurfaceParityPass(Pass):
             yield from self._diff_knobs(surf)
             yield from self._diff_metrics(surf)
             yield from self._diff_ranks(surf)
+            yield from self._rank_completeness(native_dir, prefix, surf)
 
     def _diff_knobs(self, surf: NativeSurface) -> Iterator[Finding]:
         for key, (ntyp, ndef, nrel, nline) in sorted(surf.knobs.items()):
@@ -378,6 +382,36 @@ class SurfaceParityPass(Pass):
         for key, (_t, _d, rel, line) in surf.knobs.items():
             return rel, line
         return "native", 1
+
+    def _rank_completeness(self, native_dir: Path, prefix: str,
+                           surf: NativeSurface) -> Iterator[Finding]:
+        """Rank-table completeness, native-internal (needs no Python
+        mirror): every mutex member must carry a rank wrapper, and every
+        rank constant must be referenced by some native code — a rank
+        nothing uses is a hierarchy the table describes but the program
+        no longer has."""
+        from tools.analyze.native_concurrency import build_index
+
+        idx = build_index(native_dir, prefix)
+        if idx is None:
+            return
+        for cls in sorted(idx.classes):
+            for name, mem in sorted(idx.classes[cls].items()):
+                if mem.kind == "mutex" and mem.rank is None:
+                    yield Finding(
+                        mem.rel, mem.line, self.id,
+                        f"native mutex member '{cls}::{name}' carries no "
+                        "DM_RANKED/kRank wrapper — it is invisible to "
+                        "the rank table and to DM_LOCK_ORDER_CHECK",
+                    )
+        for name, (value, nrel, nline) in sorted(surf.ranks.items()):
+            if idx.rank_uses.get(name, 0) == 0:
+                yield Finding(
+                    nrel, nline, self.id,
+                    f"rank constant {name}={value} is never referenced "
+                    "by any native mutex or acquisition — dead rank, "
+                    "the table has drifted from the code",
+                )
 
     def _diff_ranks(self, surf: NativeSurface) -> Iterator[Finding]:
         if self._py_ranks is None or not surf.ranks:

@@ -55,6 +55,31 @@ def main(argv: list[str] | None = None) -> int:
           f"{sum(v[0] for v in snap.values())} wall samples -> {flame}",
           file=sys.stderr)
 
+    # the native call-graph leg: the cold run above must have BUILT the
+    # clang-free C++ index (classes/functions/roots over native/) — if
+    # the three native rules silently stopped anchoring, the warm gate
+    # below would still pass on an empty workload, so check the index
+    # cache the in-process run populated before trusting the timing
+    from tools.analyze import REGISTRY
+    from tools.analyze import native_concurrency as nc
+    native_rules = {"native-guarded-field", "native-lock-order",
+                    "reactor-ownership"}
+    missing = native_rules - set(REGISTRY)
+    if missing:
+        print(f"::error::native rules absent from registry: "
+              f"{sorted(missing)}", file=sys.stderr)
+        return 1
+    built = [idx for idx in nc._INDEX_CACHE.values() if idx is not None]
+    if not built or not any(idx.functions for idx in built):
+        print("::error::cold analyze never built the native call-graph "
+              "index — the concurrency rules are not anchoring",
+              file=sys.stderr)
+        return 1
+    fns = sum(len(idx.functions) for idx in built)
+    roots = sum(len(idx.roots) for idx in built)
+    print(f"native index: {len(built)} tree(s), {fns} function(s), "
+          f"{roots} thread root(s)", file=sys.stderr)
+
     # warm leg: prime, then measure through the real CLI so the gate
     # covers key computation + cache load, not just the passes
     budget = float(os.environ.get("DEMODEL_ANALYZE_WARM_BUDGET", "0.5"))
