@@ -8,6 +8,7 @@ pulled checkpoint directly in device memory under a ``NamedSharding``
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import time
@@ -88,9 +89,28 @@ def _persist_manifest(store: Store, mkey: str, out: dict,
                     len(out["files"]) - len(rec["files"]))
     if store.has(mkey):
         store.remove(mkey)
-    store.put(mkey, json.dumps(rec).encode(),
-              {"kind": "model-manifest", "model": rec["name"],
-               "source": rec["source"]})
+    body = json.dumps(rec).encode()
+    meta = {"kind": "model-manifest", "model": rec["name"],
+            "source": rec["source"]}
+    try:
+        store.put(mkey, body, meta)
+    except OSError as e:
+        if e.errno != errno.ENOSPC:
+            raise
+        # full disk on the manifest landing: evict to budget and retry
+        # once — a tiny JSON record almost always fits after a sweep. A
+        # second ENOSPC degrades gracefully: the pulled bytes already
+        # reached their sink; only the durable record (lazy-restore
+        # registration, peer advertisement) is lost, which a re-pull or
+        # synthesize_manifest() can rebuild — not worth failing the pull.
+        _enforce_tier_budgets(store)
+        try:
+            store.put(mkey, body, meta)
+        except OSError as e2:
+            if e2.errno != errno.ENOSPC:
+                raise
+            log.warning("manifest for %s not persisted: disk full even "
+                        "after eviction (%s)", rec["name"], e2)
 
 
 def pull_to_hbm(

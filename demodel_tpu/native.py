@@ -104,6 +104,12 @@ def _configure(L: ctypes.CDLL) -> None:
     sig("dm_store_evictions", I64, [P])
     sig("dm_store_pin", None, [P, CP])
     sig("dm_store_unpin", None, [P, CP])
+    # storage-fault plane: quarantine, crash-recovery sweep, scrubber
+    sig("dm_store_quarantine", I, [P, CP])
+    sig("dm_store_recover", None, [P, c.c_double, c.POINTER(I), c.POINTER(I)])
+    sig("dm_store_scrub", I, [P, I64, c.POINTER(I64), c.POINTER(I64),
+                              c.POINTER(I)])
+    sig("dm_store_storage_stats", None, [P, c.POINTER(I64)])
     sig("dm_key_for_uri", None, [CP, CP])
     # streaming writer
     sig("dm_writer_append", I, [P, P, I64])

@@ -670,10 +670,24 @@ class RestoreServer:
         from demodel_tpu.utils import profiler
 
         profiler.ensure()
+        # background scrubber: same opt-in stance as retention — only a
+        # node with DEMODEL_SCRUB_INTERVAL_SECS set pays the import or
+        # the thread; off (the default) leaves this path inert
+        from demodel_tpu.utils.env import scrub_interval_secs
+
+        if scrub_interval_secs() > 0:
+            from demodel_tpu import scrub
+
+            scrub.ensure(self.registry.store)
         log.info("restore API listening on :%d", self.port)
         return self
 
     def stop(self) -> None:
+        import sys
+
+        scrub = sys.modules.get("demodel_tpu.scrub")
+        if scrub is not None:
+            scrub.stop_all()
         self.httpd.shutdown()
         self.httpd.server_close()
 

@@ -1,0 +1,132 @@
+"""Background scrubber: low-rate re-digest of committed store objects.
+
+Silent bit-rot in a cached object would otherwise be served until the key
+is evicted — the store trusts its commit-time digest forever. The scrubber
+walks the committed set in bounded, cursor-resumable slices (the native
+``Store::scrub_pass``), re-hashing each object against its recorded
+content address and quarantining mismatches (``quarantine/`` move + cache
+invalidation), so the next read takes a clean miss and re-fetches.
+
+Knobs (shared with the native proxy's storage maintenance thread — the
+surface-parity analyzer keeps the names in lockstep):
+
+- ``DEMODEL_SCRUB_INTERVAL_SECS`` — seconds between slices (0 = off, the
+  default: scrubbing is an opt-in for long-lived cache nodes).
+- ``DEMODEL_SCRUB_RATE_MB_S`` — re-digest budget; each slice reads at
+  most ``rate × interval`` bytes, so verification never contends with
+  serving.
+
+Dep-light by design (stdlib + the store wrapper): the restore server
+starts one scrubber per store on nodes that never import jax.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from demodel_tpu.store import Store
+from demodel_tpu.utils import trace
+from demodel_tpu.utils.env import scrub_interval_secs, scrub_rate_mb_s
+from demodel_tpu.utils.logging import get_logger
+from demodel_tpu.utils.metrics import HUB
+
+log = get_logger("scrub")
+
+#: pre-register the scrubber counter families at import so a scrape types
+#: them before the first slice runs
+HUB.inc("scrub_objects_total", 0)
+HUB.inc("scrub_bytes_total", 0)
+HUB.inc("scrub_mismatch_total", 0)
+HUB.inc("scrub_passes_total", 0)
+
+
+class Scrubber:
+    """One store's background scrub loop: every interval, one bounded
+    re-digest slice through the native cursor (mismatches quarantined
+    inside the store; counters mirrored into the hub here)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> bool:
+        if scrub_interval_secs() <= 0 or self._thread is not None:
+            return False
+        self._thread = threading.Thread(target=self._run,
+                                        name="store-scrub", daemon=True)
+        self._thread.start()
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    def running(self) -> bool:
+        return self._thread is not None
+
+    def _run(self) -> None:
+        while not self._stop.wait(scrub_interval_secs()):
+            try:
+                self.slice()
+            except OSError as e:
+                # a scrub slice must never kill the loop — the disk it
+                # reads is exactly the flaky thing being defended against
+                log.warning("scrub slice failed: %s", e)
+
+    def slice(self) -> tuple[bool, int, int, int]:
+        """One bounded scrub slice (public for tests and manual kicks).
+        Returns ``(wrapped, objects, bytes, mismatched)``."""
+        budget = scrub_rate_mb_s() * max(1, scrub_interval_secs()) << 20
+        with trace.span("scrub.slice"):
+            wrapped, objs, nbytes, mismatched = self.store.scrub(budget)
+        HUB.inc("scrub_objects_total", objs)
+        HUB.inc("scrub_bytes_total", nbytes)
+        if mismatched:
+            HUB.inc("scrub_mismatch_total", mismatched)
+            # the native scrub quarantines internally (not through
+            # Store.quarantine), so mirror the count into the hub family
+            HUB.inc("store_quarantined_total", mismatched)
+            log.warning("scrub slice quarantined %d corrupt object(s)",
+                        mismatched)
+        if wrapped:
+            HUB.inc("scrub_passes_total")
+        return wrapped, objs, nbytes, mismatched
+
+
+_lock = threading.Lock()
+_scrubbers: dict[str, Scrubber] = {}
+
+
+def ensure(store: Store) -> Scrubber | None:
+    """Start (once per store root) the background scrubber when
+    ``DEMODEL_SCRUB_INTERVAL_SECS`` > 0; returns None when disabled."""
+    if scrub_interval_secs() <= 0:
+        return None
+    root = str(store.root)
+    with _lock:
+        sc = _scrubbers.get(root)
+        if sc is None:
+            sc = Scrubber(store)
+            sc.start()
+            _scrubbers[root] = sc
+        return sc
+
+
+def stop_all() -> None:
+    with _lock:
+        scrubbers = list(_scrubbers.values())
+        _scrubbers.clear()
+    for sc in scrubbers:
+        sc.stop()
+
+
+def snapshot() -> list[dict]:
+    """Live scrubber state for the statusz ``storage`` section."""
+    with _lock:
+        items = sorted(_scrubbers.items())
+    return [{"root": root, "running": sc.running(),
+             "interval_secs": scrub_interval_secs(),
+             "rate_mb_s": scrub_rate_mb_s()} for root, sc in items]

@@ -1669,8 +1669,13 @@ def _pull_manifest_to_hbm(model, peers, mesh, plan, source, cast_to,
             # windows, not a full redo of the device transfers
             merge_placement(placement, e.partial)
             # the phase split for what DID land — the flaky-pull case is
-            # exactly where the fetch/place diagnosis matters most
+            # exactly where the fetch/place diagnosis matters most. The
+            # resumed remainder below accumulates no phase timings, so
+            # flag the split as partial: a consumer summing phase_secs
+            # against wall-clock must not mistake pre-failure seconds
+            # for the whole pull's
             report["phase_secs"] = e.partial.phase_secs
+            report["phase_secs_partial"] = True
             resume_skip = set(e.partial.arrays)
             log.warning("pipelined delivery failed (%s); %d tensors "
                         "landed — resuming the rest with per-file "

@@ -12,6 +12,7 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import json
+import os
 from pathlib import Path
 from typing import Iterator
 
@@ -24,13 +25,38 @@ def key_for_uri(uri: str) -> str:
     return hashlib.sha256(uri.encode()).hexdigest()[:16]
 
 
+# Test-only disk fault hook (tests/chaosdisk.py): when installed, store
+# mutations and reads consult it before touching the native layer; the hook
+# either returns (no fault) or raises OSError(ENOSPC/EIO/...). Production
+# never installs one, so the cost is a single module-attribute load. The
+# native selftest binaries carry the equivalent twin behind
+# -DDM_STORE_FAULT_INJECT, programmed via DEMODEL_STORE_FAULT.
+_fault_hook = None
+
+
+def set_fault_hook(hook) -> None:
+    """Install (or with ``None`` clear) the test-only disk fault hook."""
+    global _fault_hook
+    _fault_hook = hook
+
+
+def _fault(op: str, key: str, **info) -> None:
+    if _fault_hook is not None:
+        _fault_hook(op, key, **info)
+
+
 class StoreWriter:
-    def __init__(self, lib: ctypes.CDLL, handle: int):
+    def __init__(self, lib: ctypes.CDLL, handle: int,
+                 store: "Store | None" = None, key: str | None = None):
         self._lib = lib
         self._h = handle
         self._open = True
+        self._store = store
+        self._key = key
 
     def append(self, data: bytes) -> None:
+        if self._key is not None:
+            _fault("append", self._key, offset=self.offset, length=len(data))
         rc = self._lib.dm_writer_append(self._h, data, len(data))
         if rc != 0:
             raise OSError(-rc, "store append failed")
@@ -45,6 +71,8 @@ class StoreWriter:
         return buf.value.decode()
 
     def commit(self, meta: dict) -> None:
+        if self._key is not None:
+            _fault("commit", self._key, offset=self.offset)
         rc = self._lib.dm_writer_commit(self._h, json.dumps(meta).encode())
         self._open = False
         if rc != 0:
@@ -54,6 +82,32 @@ class StoreWriter:
         if self._open:
             self._lib.dm_writer_abort(self._h, 1 if keep_partial else 0)
             self._open = False
+
+    def checkpoint(self) -> None:
+        """Durable resume point for cross-incarnation resume: fsync the
+        partial, then atomically publish a ``partial/<key>.progress``
+        sidecar carrying the landed watermark. After a crash,
+        :meth:`Store.recover` truncates the partial to this offset (bytes
+        past it may be torn) and the tier re-offers it to single-flight as
+        a resume offset — the landed prefix never re-crosses the wire."""
+        if self._store is None or self._key is None or not self._open:
+            return
+        part = self._store.root / "partial" / self._key
+        try:
+            fd = os.open(part, os.O_WRONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        # "offset" is deliberately a JSON *string*: the native recover
+        # sweep parses the sidecar with the same string-field scanner it
+        # uses for .meta, and must agree on the watermark
+        doc = {"offset": str(self.offset), "sha256": self.digest()}
+        tmp = part.with_name(part.name + ".progress.tmp")
+        tmp.write_text(json.dumps(doc))
+        os.replace(tmp, part.with_name(part.name + ".progress"))
 
 
 class RangeStoreWriter:
@@ -159,6 +213,7 @@ class Store:
 
     # -- reads -----------------------------------------------------------
     def pread(self, key: str, length: int, offset: int) -> bytes:
+        _fault("pread", key, length=length, offset=offset)
         buf = ctypes.create_string_buffer(length)
         n = self._lib.dm_store_pread(self._h, key.encode(), buf, length, offset)
         if n < 0:
@@ -202,7 +257,7 @@ class Store:
                                      1 if resume else 0, err, 256)
         if not h:
             raise OSError(f"begin {key}: {err.value.decode()}")
-        return StoreWriter(self._lib, h)
+        return StoreWriter(self._lib, h, store=self, key=key)
 
     def begin_ranged(self, key: str, total: int) -> RangeStoreWriter:
         err = ctypes.create_string_buffer(256)
@@ -253,6 +308,71 @@ class Store:
         meta_is_private)."""
         meta = self.meta(key) or {}
         return bool(meta.get("auth_scope"))
+
+    # -- storage-fault plane ---------------------------------------------
+    def recover(self, grace_secs: float = 60.0) -> tuple[int, int]:
+        """Crash-recovery sweep over ``partial/`` (native ``Store::recover``;
+        already run once at open with a 60 s grace). Partials older than the
+        grace carrying a ``.progress`` sidecar are truncated to their durable
+        watermark and kept as resume offers; sidecar-less stale partials,
+        orphan sidecars and stale tmp files are purged. Returns
+        ``(resumed, purged)``."""
+        resumed = ctypes.c_int(0)
+        purged = ctypes.c_int(0)
+        self._lib.dm_store_recover(self._h, float(grace_secs),
+                                   ctypes.byref(resumed), ctypes.byref(purged))
+        return resumed.value, purged.value
+
+    def quarantine(self, key: str) -> bool:
+        """Move a committed object out of the addressable namespace into
+        ``quarantine/`` (EIO or digest mismatch on read), invalidating the
+        hot tier, fd cache and index — the next request is a clean miss.
+        Returns True when the object was quarantined."""
+        rc = self._lib.dm_store_quarantine(self._h, key.encode())
+        if rc == 0:
+            from demodel_tpu.utils import metrics as _m
+
+            _m.HUB.inc("store_quarantined_total")
+        return rc == 0
+
+    def probe_writable(self) -> bool:
+        """One small real write+fsync through the store's write path —
+        the degraded-mode exit probe (test fault hooks are honored, so an
+        injected full disk keeps the node degraded)."""
+        probe_key = "probe-degraded._demodel"
+        try:
+            _fault("probe", probe_key)
+            self.put(probe_key, b"ok", {"kind": "probe", "auth_scope": "probe"})
+        except OSError:
+            return False
+        try:
+            self.remove(probe_key)
+        except OSError:
+            pass
+        return True
+
+    def scrub(self, max_bytes: int) -> tuple[bool, int, int, int]:
+        """One bounded background-scrubber slice: re-digest up to
+        ``max_bytes`` of committed objects from the saved cursor,
+        quarantining any object whose bytes no longer hash to the recorded
+        sha256. Returns ``(wrapped, objects, bytes, mismatched)``;
+        ``wrapped`` is True when the pass completed a full walk."""
+        objs = ctypes.c_int64(0)
+        nbytes = ctypes.c_int64(0)
+        mism = ctypes.c_int(0)
+        rc = self._lib.dm_store_scrub(self._h, max_bytes, ctypes.byref(objs),
+                                      ctypes.byref(nbytes), ctypes.byref(mism))
+        return bool(rc), objs.value, nbytes.value, mism.value
+
+    def storage_stats(self) -> dict:
+        out = (ctypes.c_int64 * 4)()
+        self._lib.dm_store_storage_stats(self._h, out)
+        return {
+            "quarantined_total": out[0],
+            "scrub_objects_total": out[1],
+            "scrub_bytes_total": out[2],
+            "scrub_mismatch_total": out[3],
+        }
 
     def pin(self, key: str) -> None:
         """Shield ``key`` from :meth:`gc` eviction (process-local). The

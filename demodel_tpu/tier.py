@@ -30,6 +30,7 @@ nodes that must never pay a jax import. statusz reads
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import mmap
 import os
@@ -40,7 +41,8 @@ from typing import Any, Callable, Iterable
 
 from demodel_tpu.store import Store
 from demodel_tpu.utils import trace
-from demodel_tpu.utils.env import cache_max_gb, default_tier_ram_mb
+from demodel_tpu.utils.env import (cache_max_gb, default_tier_ram_mb,
+                                   store_reprobe_secs)
 from demodel_tpu.utils.faults import DigestMismatch
 from demodel_tpu.utils.logging import get_logger
 from demodel_tpu.utils.metrics import HUB, labeled
@@ -58,6 +60,16 @@ HUB.inc(labeled("store_tier_evicted_bytes_total", tier="ram"), 0)
 HUB.inc("singleflight_leaders_total", 0)
 HUB.inc("singleflight_waiters_total", 0)
 HUB.inc("singleflight_handoffs_total", 0)
+#: storage-fault plane families (ISSUE 19): quarantines are counted by
+#: Store.quarantine; degraded transitions and the 0/1 mode gauge live here
+HUB.inc("store_quarantined_total", 0)
+HUB.inc("store_degraded_entries_total", 0)
+HUB.set_gauge("store_degraded", 0)
+
+#: leader checkpoint cadence: every this-many landed bytes the partial is
+#: fsync'd and the .progress watermark sidecar rewritten, bounding what a
+#: kill -9 can force the next incarnation to refetch
+_CHECKPOINT_BYTES = 8 << 20
 
 
 def _tick(name: str, tier: str | None = None, n: int = 1) -> None:
@@ -203,7 +215,11 @@ class HotTier:
             if not self._digest_matches(key, path, digest):
                 mm.close()
                 log.warning("hot-tier promotion refused: %s fails digest "
-                            "verification", key)
+                            "verification — quarantining", key)
+                # bit-rot caught on the read path: move the object out of
+                # the addressable namespace so the next request re-fetches
+                # instead of re-verifying the same corrupt bytes forever
+                self.store.quarantine(key)
                 return False
             with self._lock:
                 if key in self._objs:  # lost a promote race; keep the
@@ -312,6 +328,11 @@ class _Flight:
         self.leader_needed = False  # the leader died; next waiter claims
         self.waiters = 0
         self.handoffs = 0
+        #: degraded read-through relay: when the disk can't land bytes the
+        #: leader accumulates the object here instead of in partial/<key>;
+        #: waiters read this buffer off the watermark and the herd still
+        #: collapses onto one upstream stream
+        self.buf: bytearray | None = None
 
     # leader side ---------------------------------------------------------
     def set_watermark(self, n: int) -> None:
@@ -322,6 +343,21 @@ class _Flight:
     def advance(self, n: int) -> None:
         with self.cv:
             self.watermark += n
+            self.cv.notify_all()
+
+    def start_relay(self, prefix: bytes) -> None:
+        """Switch the flight to in-memory relay mode (degraded
+        read-through), seeding it with whatever prefix already landed."""
+        with self.cv:
+            self.buf = bytearray(prefix)
+            self.watermark = len(self.buf)
+            self.cv.notify_all()
+
+    def relay(self, chunk: bytes) -> None:
+        with self.cv:
+            assert self.buf is not None
+            self.buf += chunk
+            self.watermark = len(self.buf)
             self.cv.notify_all()
 
     def finish(self, ok: bool, error: BaseException | None = None) -> None:
@@ -468,8 +504,54 @@ class TieredStore:
         self.name = name
         self.hot = HotTier(store, hot_budget)
         self.flights = SingleFlight()
+        # degraded read-through mode (storage-fault plane): entered when
+        # an emergency-evicted disk still refuses a landing write; misses
+        # then stream upstream → caller without landing bytes until a
+        # rate-limited re-probe sees the disk accept writes again
+        self._degraded_lock = threading.Lock()
+        self._degraded = False
+        self._degraded_since = 0.0
+        self._degraded_entries = 0
+        self._last_probe = 0.0
         with _tier_registry_lock:
             _tier_registry.add(self)
+
+    # -- degraded read-through mode --------------------------------------
+    def degraded(self) -> bool:
+        with self._degraded_lock:
+            return self._degraded
+
+    def _enter_degraded(self, err: BaseException) -> None:
+        with self._degraded_lock:
+            if self._degraded:
+                return
+            self._degraded = True
+            self._degraded_since = time.time()
+            self._degraded_entries += 1
+        HUB.set_gauge("store_degraded", 1)
+        HUB.inc("store_degraded_entries_total")
+        log.warning("store write failed (%s) after emergency eviction: "
+                    "entering degraded read-through mode — misses stream "
+                    "uncached until the disk accepts writes again", err)
+
+    def _maybe_exit_degraded(self) -> None:
+        """Rate-limited re-probe (``DEMODEL_STORE_REPROBE_SECS``): one
+        small real write through the store's write path; success exits
+        degraded mode automatically."""
+        with self._degraded_lock:
+            if not self._degraded:
+                return
+            now = time.monotonic()
+            if now - self._last_probe < store_reprobe_secs():
+                return
+            self._last_probe = now
+        if self.store.probe_writable():
+            with self._degraded_lock:
+                self._degraded = False
+                self._degraded_since = 0.0
+            HUB.set_gauge("store_degraded", 0)
+            log.info("store writable again: leaving degraded read-through "
+                     "mode")
 
     # -- the read path ---------------------------------------------------
     def read(self, key: str,
@@ -483,11 +565,24 @@ class TieredStore:
         if hot is not None:
             return hot
         _tick("store_tier_misses_total", "ram")
+        self._maybe_exit_degraded()
         if self.store.has(key):
             _tick("store_tier_hits_total", "disk")
-            body = self.store.get(key)
-            self.hot.promote(key)
-            return body
+            try:
+                body = self.store.get(key)
+            except OSError as e:
+                if e.errno != errno.EIO:
+                    raise
+                # EIO on a committed object: the media under it is bad —
+                # quarantine (hot tier + fd cache + index invalidated by
+                # the store) and re-enter the miss path below
+                self.hot.invalidate(key)
+                self.store.quarantine(key)
+                log.warning("EIO reading committed object %s: quarantined, "
+                            "re-entering miss path", key)
+            else:
+                self.hot.promote(key)
+                return body
         _tick("store_tier_misses_total", "disk")
         if fetch is None:
             raise KeyError(key)
@@ -501,6 +596,11 @@ class TieredStore:
               meta: dict | None, expected_digest: str | None) -> bytes:
         key = flight.key
         _tick("singleflight_leaders_total")
+        if self.degraded():
+            # degraded read-through: no landing write may even be tried —
+            # stream upstream → cohort through the in-memory relay
+            return self._lead_relay(flight, fetch, expected_digest,
+                                    stream=None, prefix=b"")
         with trace.span("tier.lead", key=key):
             try:
                 w = self.store.begin(key, resume=True)
@@ -510,11 +610,47 @@ class TieredStore:
                 self.flights.finish(key, flight)
                 flight.finish(ok=False, error=e)
                 raise
+            relaying = False
             try:
+                with flight.cv:
+                    flight.buf = None  # takeover after relay: disk again
                 flight.set_watermark(w.offset)
-                for chunk in fetch(key, w.offset):
-                    w.append(chunk)
+                stream = iter(fetch(key, w.offset))
+                unsynced = 0
+                for chunk in stream:
+                    try:
+                        w.append(chunk)
+                    except OSError as e:
+                        if e.errno != errno.ENOSPC:
+                            raise
+                        # full disk mid-landing: emergency eviction + ONE
+                        # retry; a still-full disk flips the node into
+                        # degraded read-through and the cohort keeps
+                        # streaming off an in-memory relay seeded with
+                        # the durably landed prefix
+                        self.enforce()
+                        try:
+                            w.append(chunk)
+                        except OSError as e2:
+                            if e2.errno != errno.ENOSPC:
+                                raise
+                            self._enter_degraded(e2)
+                            prefix = _partial_bytes(self.store, key,
+                                                    w.offset)
+                            w.checkpoint()
+                            w.abort(keep_partial=True)
+                            relaying = True
+                            return self._lead_relay(
+                                flight, fetch, expected_digest,
+                                stream=stream, prefix=prefix + chunk)
                     flight.advance(len(chunk))
+                    unsynced += len(chunk)
+                    if unsynced >= _CHECKPOINT_BYTES:
+                        # durable resume point: a kill -9 past here costs
+                        # the next incarnation at most _CHECKPOINT_BYTES
+                        # of refetch (Store.recover truncates to this)
+                        w.checkpoint()
+                        unsynced = 0
                 digest = w.digest()
                 if expected_digest and digest != expected_digest:
                     # drop the partial: the BYTES are wrong, resuming
@@ -526,19 +662,69 @@ class TieredStore:
                     self.flights.finish(key, flight)
                     flight.finish(ok=False, error=err)
                     raise err
-                w.commit(meta or {})
+                try:
+                    w.commit(meta or {})
+                except OSError as e:
+                    if e.errno != errno.ENOSPC:
+                        raise
+                    # commit-time ENOSPC (meta sidecar): the body is fully
+                    # durable in the partial — release the writer guard
+                    # keeping the partial (no-op when the native commit
+                    # already released it), evict, re-open (resume
+                    # rehashes the partial) and publish again
+                    w.abort(keep_partial=True)
+                    self.enforce()
+                    self.store.begin(key, resume=True).commit(meta or {})
             except DigestMismatch:
                 raise
             except BaseException as e:
-                w.abort(keep_partial=True)
-                if not flight.resign(e):
-                    self.flights.finish(key, flight)
+                if not relaying:
+                    w.abort(keep_partial=True)
+                    if not flight.resign(e):
+                        self.flights.finish(key, flight)
                 raise
             self.flights.finish(key, flight)
             flight.finish(ok=True)
             body = self.store.get(key)
             self.hot.promote(key)
             return body
+
+    def _lead_relay(self, flight: _Flight,
+                    fetch: Callable[[str, int], Iterable[bytes]],
+                    expected_digest: str | None,
+                    stream: "Iterable[bytes] | None",
+                    prefix: bytes) -> bytes:
+        """Degraded read-through leader: upstream → cohort through the
+        flight's in-memory relay, landing nothing on disk. ``stream``
+        continues a partially-consumed fetch iterator (the mid-stream
+        ENOSPC switch); ``prefix`` is whatever had already landed."""
+        key = flight.key
+        with trace.span("tier.lead_degraded", key=key):
+            try:
+                flight.start_relay(prefix)
+                if stream is None:
+                    stream = iter(fetch(key, len(prefix)))
+                for chunk in stream:
+                    flight.relay(chunk)
+                buf = bytes(flight.buf or b"")
+                if expected_digest:
+                    digest = hashlib.sha256(buf).hexdigest()
+                    if digest != expected_digest:
+                        err = DigestMismatch(
+                            f"{key}: got {digest[:12]}, "
+                            f"want {expected_digest[:12]} (degraded)")
+                        self.flights.finish(key, flight)
+                        flight.finish(ok=False, error=err)
+                        raise err
+            except DigestMismatch:
+                raise
+            except BaseException as e:
+                if not flight.resign(e):
+                    self.flights.finish(key, flight)
+                raise
+            self.flights.finish(key, flight)
+            flight.finish(ok=True)
+            return buf
 
     def _follow(self, flight: _Flight,
                 fetch: Callable[[str, int], Iterable[bytes]],
@@ -584,9 +770,16 @@ class TieredStore:
                         return self._lead(flight, fetch, meta,
                                           expected_digest)
                     if wm > len(out):
-                        if fd < 0:
-                            fd = os.open(part_path, os.O_RDONLY)
+                        # degraded read-through: the leader relays through
+                        # the flight buffer instead of the partial
+                        with flight.cv:
+                            relay = flight.buf
+                            if relay is not None:
+                                out += bytes(
+                                    relay[len(out):min(wm, len(relay))])
                         while len(out) < wm:
+                            if fd < 0:
+                                fd = os.open(part_path, os.O_RDONLY)
                             chunk = os.pread(fd, wm - len(out), len(out))
                             if not chunk:
                                 break  # torn rename edge: retry via store
@@ -595,6 +788,12 @@ class TieredStore:
                         if not ok:
                             raise flight.error or OSError(
                                 f"single-flight fetch of {key} failed")
+                        with flight.cv:
+                            relay = flight.buf
+                        if relay is not None:
+                            if len(out) < len(relay):
+                                out += bytes(relay[len(out):])
+                            return bytes(out)
                         if len(out) < flight.watermark:
                             # never opened the partial (commit landed
                             # between waits) — read the published object
@@ -626,10 +825,30 @@ class TieredStore:
         max_gb = cache_max_gb()
         doc["tiers"].append({"tier": "disk",
                              "max_bytes": max_gb << 30 if max_gb else 0})
+        with self._degraded_lock:
+            storage = {"degraded": self._degraded,
+                       "degraded_since": self._degraded_since,
+                       "degraded_entries": self._degraded_entries}
+        storage.update(self.store.storage_stats())
+        doc["storage"] = storage
         return doc
 
     def close(self) -> None:
         self.hot.close()
+
+
+def _partial_bytes(store: Store, key: str, size: int) -> bytes:
+    """The durably landed prefix of ``partial/<key>`` — the relay seed for
+    a mid-stream degraded switch (waiters already streamed these bytes, so
+    a short read here must fail the flight, not desync it)."""
+    if size <= 0:
+        return b""
+    path = os.path.join(str(store.root), "partial", key)
+    with open(path, "rb") as f:
+        data = f.read(size)
+    if len(data) != size:
+        raise OSError(errno.EIO, f"partial prefix short for {key}")
+    return data
 
 
 def enforce_disk_budget(store: Store) -> None:

@@ -197,3 +197,26 @@ def proxy_ktls() -> bool:
     MITM'd cache hits (on by default; availability is runtime-probed and
     the chunked ``SSL_write`` pump is the automatic fallback)."""
     return env_bool("DEMODEL_PROXY_KTLS", True)
+
+
+def store_reprobe_secs() -> int:
+    """``DEMODEL_STORE_REPROBE_SECS``: how often a node in degraded
+    read-through mode re-probes the store with a small real write; a
+    successful probe exits the mode automatically. Shared with the
+    native proxy's storage maintenance thread."""
+    return env_int("DEMODEL_STORE_REPROBE_SECS", 10, minimum=1)
+
+
+def scrub_interval_secs() -> int:
+    """``DEMODEL_SCRUB_INTERVAL_SECS``: seconds between background
+    scrubber slices re-digesting committed objects (0, the default,
+    disables the scrubber on both planes)."""
+    return env_int("DEMODEL_SCRUB_INTERVAL_SECS", 0, minimum=0)
+
+
+def scrub_rate_mb_s() -> int:
+    """``DEMODEL_SCRUB_RATE_MB_S``: the scrubber's re-digest budget in
+    MB per second — each slice reads at most ``rate × interval`` bytes,
+    so a cold cache is verified slowly enough to never contend with
+    serving."""
+    return env_int("DEMODEL_SCRUB_RATE_MB_S", 8, minimum=1)

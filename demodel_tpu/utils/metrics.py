@@ -229,7 +229,7 @@ def parse_labels(name: str) -> tuple[str, dict[str, str]]:
 #: in-flight EPOLLOUT drains / spliced CONNECT tunnels
 PROXY_GAUGES = frozenset({"sessions_active", "sessions_queue_depth",
                           "sessions_parked", "conns_writing",
-                          "tunnels_spliced"})
+                          "tunnels_spliced", "store_degraded"})
 
 
 # ------------------------------------------------------- telemetry plane

@@ -31,8 +31,9 @@ _START_MONOTONIC = time.monotonic()
 _START_WALL = time.time()
 
 #: v2 added the ``tiers`` section (RAM/disk occupancy, budgets, in-flight
-#: single-flight leaders) on both planes
-SCHEMA_VERSION = 2
+#: single-flight leaders) on both planes; v3 added the ``storage``
+#: section (degraded read-through state, quarantine/scrub counters)
+SCHEMA_VERSION = 3
 
 
 def _breakers() -> dict[str, dict[str, Any]]:
@@ -75,6 +76,27 @@ def _tiers() -> list[dict[str, Any]]:
     if tier is None:
         return []
     out: list[dict[str, Any]] = tier.tiers_snapshot()
+    return out
+
+
+def _storage() -> dict[str, Any]:
+    """Storage-fault plane state: per-TieredStore degraded read-through
+    flags and quarantine/scrub counters, plus live background scrubbers
+    (``sys.modules`` peeks — a scrape never allocates the singletons;
+    the native proxy composes its own twin of this section)."""
+    out: dict[str, Any] = {}
+    tier = sys.modules.get("demodel_tpu.tier")
+    if tier is not None:
+        rows = []
+        for t in tier.tiers_snapshot():
+            storage = t.get("storage")
+            if storage:
+                rows.append({"name": t.get("name"), **storage})
+        if rows:
+            out["tiers"] = rows
+    scrub = sys.modules.get("demodel_tpu.scrub")
+    if scrub is not None:
+        out["scrubbers"] = scrub.snapshot()
     return out
 
 
@@ -152,6 +174,9 @@ def _knob_rows() -> list[tuple[str, Any]]:
         ("DEMODEL_PROFILE_HZ", env.profile_hz()),
         ("DEMODEL_PROFILE_MAX_STACKS", env.profile_max_stacks()),
         ("DEMODEL_PROFILE_WINDOW_S", env.profile_window_s()),
+        ("DEMODEL_STORE_REPROBE_SECS", env.store_reprobe_secs()),
+        ("DEMODEL_SCRUB_INTERVAL_SECS", env.scrub_interval_secs()),
+        ("DEMODEL_SCRUB_RATE_MB_S", env.scrub_rate_mb_s()),
     ]
 
 
@@ -234,6 +259,7 @@ def snapshot(extra: dict[str, Any] | None = None) -> dict[str, Any]:
         "budgets": _budgets(),
         "swarm": _swarm(),
         "tiers": _tiers(),
+        "storage": _storage(),
         "gossip": _gossip(),
         "config": effective_config(),
         "profiler": _profiler(),

@@ -426,7 +426,8 @@ std::string Metrics::json() const {
              "\"reactor_wakeups_total\":%llu,"
              "\"conns_writing\":%llu,\"tunnels_spliced\":%llu,"
              "\"write_stall_evictions_total\":%llu,\"sendfile_bytes_total\":%llu,"
-             "\"ktls_sends_total\":%llu,\"splice_bytes_total\":%llu}",
+             "\"ktls_sends_total\":%llu,\"splice_bytes_total\":%llu,"
+             "\"store_degraded\":%llu}",
              (unsigned long long)connects.load(), (unsigned long long)mitm.load(),
              (unsigned long long)tunnel.load(), (unsigned long long)requests.load(),
              (unsigned long long)cache_hits.load(), (unsigned long long)cache_misses.load(),
@@ -444,7 +445,8 @@ std::string Metrics::json() const {
              (unsigned long long)write_stall_evictions.load(),
              (unsigned long long)sendfile_bytes.load(),
              (unsigned long long)ktls_sends.load(),
-             (unsigned long long)splice_bytes.load());
+             (unsigned long long)splice_bytes.load(),
+             (unsigned long long)store_degraded.load());
   return buf;
 }
 
@@ -711,6 +713,9 @@ class Session {
             if (errno == EINTR) continue;
             if (errno == EAGAIN || errno == EWOULDBLOCK)
               return WriteRc::kAgain;
+            // EIO here is the FILE side of the copy (socket failures
+            // surface as EPIPE/ECONNRESET): quarantine the object
+            p_->note_store_read_error(ws->key, -errno);
             return WriteRc::kError;
           }
           if (n == 0) return WriteRc::kError;  // store object truncated
@@ -741,7 +746,10 @@ class Session {
               int64_t got =
                   p_->store_->pread(ws->key, ws->buf.data(),
                                     static_cast<int64_t>(chunk), ws->off);
-              if (got <= 0) return WriteRc::kError;
+              if (got <= 0) {
+                if (got < 0) p_->note_store_read_error(ws->key, got);
+                return WriteRc::kError;
+              }
               ws->buf.resize(static_cast<size_t>(got));
               ws->buf_off = 0;
             }
@@ -1640,7 +1648,8 @@ class Session {
     // this for 206s all along).
     Writer *sf_w = nullptr;
     std::shared_ptr<FillState> sf_fill;
-    if (cacheable && is_get && p_->store_ && range.empty()) {
+    if (cacheable && is_get && p_->store_ && range.empty() &&
+        !p_->storage_degraded()) {
       std::string werr;
       sf_w = p_->store_->begin(key, false, &werr);
       if (sf_w) {
@@ -1833,6 +1842,9 @@ class Session {
                              const std::string &key, const std::string &auth_scope,
                              const std::string &authority, const std::string &host,
                              int port, bool tls) {
+    // degraded read-through: no fill may start, so the ranged request is
+    // forwarded unmodified (uncached) — the -1 contract below
+    if (p_->storage_degraded()) return -1;
     std::string werr;
     Writer *w = p_->store_->begin(key, false, &werr);
     if (!w) return -1;  // concurrent writer → that session fills the cache
@@ -2430,7 +2442,7 @@ class Session {
                           !resp.headers.get("www-authenticate").empty();
     bool do_cache = cacheable &&
                     (resp.status == 200 || lfs_redirect || auth_challenge) &&
-                    !head_only && p_->store_;
+                    !head_only && p_->store_ && !p_->storage_degraded();
     // Honor response caching directives (VERDICT r1 missing #6): no-store
     // is absolute; private bodies are only cached when the request carried
     // credentials (the entry is then auth-scoped to that credential and
@@ -2445,7 +2457,8 @@ class Session {
     // private policy as the GET tee path above)
     bool cache_headless_redirect =
         cacheable && lfs_redirect && head_only && content_len <= 0 &&
-        p_->store_ && cc.find("no-store") == std::string::npos &&
+        p_->store_ && !p_->storage_degraded() &&
+        cc.find("no-store") == std::string::npos &&
         (cc.find("private") == std::string::npos || !auth_scope.empty());
     auto finish_fill = [&](bool fill_ok) {
       if (!fill) return;
@@ -2536,14 +2549,27 @@ class Session {
     bool client_ok = true;
     bool upstream_ok = true;
     auto emit = [&](const char *data, size_t n) {
-      if (do_cache && w && w->append(data, static_cast<int64_t>(n)) != 0) {
-        // disk error mid-tee (e.g. ENOSPC): the partial is inconsistent, so
-        // drop it entirely and keep streaming to the client uncached
-        w->abort(false);
-        delete w;
-        w = nullptr;
-        do_cache = false;
-        finish_fill(false);  // attached readers can't proceed either
+      if (do_cache && w) {
+        int arc = w->append(data, static_cast<int64_t>(n));
+        if (arc == -ENOSPC) {
+          // full disk mid-tee: emergency eviction + ONE retry keeps the
+          // tee alive when LRU space exists; a still-full disk flips the
+          // node into degraded read-through mode (all fill paths vetoed
+          // until the maintenance re-probe sees writes succeed again)
+          if (p_->cfg_.cache_max_bytes > 0)
+            p_->store_->gc(p_->cfg_.cache_max_bytes, nullptr, nullptr);
+          arc = w->append(data, static_cast<int64_t>(n));
+          if (arc == -ENOSPC) p_->enter_degraded(ENOSPC);
+        }
+        if (arc != 0) {
+          // disk error mid-tee: the partial is inconsistent, so drop it
+          // entirely and keep streaming to the client uncached
+          w->abort(false);
+          delete w;
+          w = nullptr;
+          do_cache = false;
+          finish_fill(false);  // attached readers can't proceed either
+        }
       }
       if (fill && w) {
         {
@@ -2687,6 +2713,7 @@ class Session {
           ssize_t n = ::sendfile(client_.fd, fd, &pos, want);
           if (n < 0 && errno == EINTR) continue;
           if (n <= 0) {
+            if (n < 0) p_->note_store_read_error(loc.key, -errno);
             ok = false;
             break;
           }
@@ -2703,7 +2730,10 @@ class Session {
     while (sent < len) {
       int64_t want = std::min<int64_t>(len - sent, (int64_t)buf.size());
       int64_t n = p_->store_->pread(loc.key, buf.data(), want, abs_off + sent);
-      if (n <= 0) return false;
+      if (n <= 0) {
+        if (n < 0) p_->note_store_read_error(loc.key, n);
+        return false;
+      }
       if (!client_.write_all(buf.data(), static_cast<size_t>(n))) return false;
       sent += n;
       p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
@@ -2755,9 +2785,13 @@ class Session {
       // status + WWW-Authenticate + body, so the token dance starts
       // offline exactly as it would against the live registry
       std::string body(static_cast<size_t>(size), 0);
-      if (size > 0 &&
-          p_->store_->pread(key, body.data(), size, 0) != size)
-        return false;
+      if (size > 0) {
+        int64_t got = p_->store_->pread(key, body.data(), size, 0);
+        if (got != size) {
+          if (got < 0) p_->note_store_read_error(key, got);
+          return false;
+        }
+      }
       std::string head = "HTTP/1.1 401 Unauthorized\r\n";
       std::string www = meta_field("www-authenticate");
       if (!www.empty()) head += "WWW-Authenticate: " + www + "\r\n";
@@ -2840,7 +2874,10 @@ class Session {
       while (got < len) {
         int64_t n = p_->store_->pread(key, body.data() + got, len - got,
                                       off + got);
-        if (n <= 0) return false;
+        if (n <= 0) {
+          if (n < 0) p_->note_store_read_error(key, n);
+          return false;
+        }
         got += n;
       }
       route_ttfb();
@@ -2882,6 +2919,7 @@ class Session {
           ssize_t n = ::sendfile(client_.fd, fd, &pos, want);
           if (n < 0 && errno == EINTR) continue;
           if (n <= 0) {
+            if (n < 0) p_->note_store_read_error(key, -errno);
             ok = false;
             break;
           }
@@ -2926,7 +2964,10 @@ class Session {
     while (sent < len) {
       int64_t want = std::min<int64_t>(len - sent, (int64_t)buf.size());
       int64_t n = p_->store_->pread(key, buf.data(), want, off + sent);
-      if (n <= 0) return false;
+      if (n <= 0) {
+        if (n < 0) p_->note_store_read_error(key, n);
+        return false;
+      }
       if (!client_.write_all(buf.data(), static_cast<size_t>(n))) return false;
       sent += n;
       p_->metrics_.bytes_cache += static_cast<uint64_t>(n);
@@ -3194,6 +3235,86 @@ void Proxy::maybe_gc() {
               evicted, (long long)freed);
 }
 
+// ---- storage-fault plane ---------------------------------------------
+
+void Proxy::enter_degraded(int err) {
+  if (!store_degraded_.exchange(true)) {
+    degraded_entries_.fetch_add(1, std::memory_order_relaxed);
+    degraded_since_wall_.store(static_cast<int64_t>(::time(nullptr)),
+                               std::memory_order_relaxed);
+    ::fprintf(stderr,
+              "[demodel-tpu] store write failed (%s) after emergency gc: "
+              "entering degraded read-through mode (misses stream "
+              "uncached; re-probe every %ds)\n",
+              dm_strerror(err).c_str(), reprobe_secs_);
+  }
+}
+
+bool Proxy::probe_store_writable() {
+  // a REAL write through the store's Writer path (not a bare open/write)
+  // so an injected DEMODEL_STORE_FAULT is honored and the probe measures
+  // exactly what a fill would hit; the probe object is auth-scoped so it
+  // never shows up in the peer index, and is removed on success
+  if (!store_) return false;
+  static const char kProbeKey[] = "probe-degraded._demodel";
+  char digest[65];
+  int rc = store_->put(kProbeKey, "ok", 2,
+                       "{\"kind\": \"probe\", \"auth_scope\": \"probe\"}",
+                       digest);
+  if (rc == 0) store_->remove(kProbeKey);
+  return rc == 0;
+}
+
+void Proxy::storage_loop() {
+  int64_t tick = 0;
+  while (running_.load()) {
+    {
+      // wait_until on the SYSTEM clock, same rationale as profile_loop:
+      // a steady-clock wait_for lowers to pthread_cond_clockwait, which
+      // older libtsan builds do not intercept (bogus double-lock reports)
+      std::unique_lock<std::mutex> lk(storage_wake_mu_);
+      storage_wake_cv_.wait_until(
+          lk, std::chrono::system_clock::now() + std::chrono::seconds(1),
+          [&] { return !running_.load(); });
+    }
+    if (!running_.load()) break;
+    tick++;
+    if (store_degraded_.load(std::memory_order_relaxed) &&
+        reprobe_secs_ > 0 && tick % reprobe_secs_ == 0 &&
+        probe_store_writable() &&
+        store_degraded_.exchange(false, std::memory_order_relaxed)) {
+      // the exchange is the atomic clear: a concurrent degraded entry
+      // between the gate load and here keeps its own since/entries
+      // bookkeeping (exchange returning false = someone else cleared)
+      degraded_since_wall_.store(0, std::memory_order_relaxed);
+      ::fprintf(stderr,
+                "[demodel-tpu] store writable again: leaving degraded "
+                "read-through mode\n");
+    }
+    if (scrub_interval_secs_ > 0 && tick % scrub_interval_secs_ == 0) {
+      // one bounded re-digest slice per interval: rate × interval bytes,
+      // mismatches quarantined inside Store::scrub_pass
+      int64_t budget = static_cast<int64_t>(scrub_rate_mb_s_) *
+                       scrub_interval_secs_ * (1ll << 20);
+      int mismatched = 0;
+      store_->scrub_pass(budget, nullptr, nullptr, &mismatched);
+      if (mismatched > 0)
+        ::fprintf(stderr,
+                  "[demodel-tpu] scrubber quarantined %d corrupt object(s)\n",
+                  mismatched);
+    }
+  }
+}
+
+void Proxy::note_store_read_error(const std::string &key, int64_t rc) {
+  if (rc != -EIO || !store_) return;
+  if (store_->quarantine(key) == 0)
+    ::fprintf(stderr,
+              "[demodel-tpu] quarantined object %s after read EIO — next "
+              "request takes the miss path\n",
+              key.c_str());
+}
+
 SSL_CTX *Proxy::upstream_ctx() {
   std::lock_guard<Mutex> g(upstream_mu_);
   if (upstream_ctx_) return upstream_ctx_;
@@ -3290,9 +3411,32 @@ std::string Proxy::metrics_json() {
       writing_count_.load() > 0 ? writing_count_.load() : 0);
   metrics_.tunnels_spliced = static_cast<uint64_t>(
       tunnel_count_.load() > 0 ? tunnel_count_.load() : 0);
+  metrics_.store_degraded =
+      store_degraded_.load(std::memory_order_relaxed) ? 1 : 0;
   // flat counters + the per-route latency histograms under "hist"
   std::string flat = metrics_.json();
   flat.pop_back();  // trailing '}'
+  {
+    // storage-fault plane counters maintained by Store (the
+    // store_degraded gauge itself rides Metrics::json above) — same
+    // names as the Python tier so fleet scrapes aggregate across planes
+    int64_t q = 0, so = 0, sb = 0, sm = 0;
+    if (store_) {
+      q = store_->quarantined_total();
+      so = store_->scrub_objects_total();
+      sb = store_->scrub_bytes_total();
+      sm = store_->scrub_mismatch_total();
+    }
+    char sbuf[320];
+    ::snprintf(sbuf, sizeof sbuf,
+               ",\"store_degraded_entries_total\":%llu,"
+               "\"store_quarantined_total\":%lld,"
+               "\"scrub_objects_total\":%lld,\"scrub_bytes_total\":%lld,"
+               "\"scrub_mismatch_total\":%lld",
+               (unsigned long long)degraded_entries_.load(), (long long)q,
+               (long long)so, (long long)sb, (long long)sm);
+    flat.append(sbuf);
+  }
   flat.append(",\"hist\":");
   flat.append(metrics_.hist_json());
   flat.append("}");
@@ -3330,7 +3474,7 @@ std::string Proxy::statusz_json() {
   char buf[1024];
   ::snprintf(
       buf, sizeof buf,
-      "{\"statusz\":2,\"server\":\"demodel-native-proxy\","
+      "{\"statusz\":3,\"server\":\"demodel-native-proxy\","
       "\"start_time\":%.3f,\"uptime_sec\":%.3f,"
       "\"config\":{\"reactor\":%s,\"session_threads\":%d,"
       "\"max_conns\":%d,\"idle_timeout_sec\":%d,\"io_timeout_sec\":%d,"
@@ -3399,6 +3543,33 @@ std::string Proxy::statusz_json() {
                (unsigned long long)metrics_.sendfile_bytes.load(),
                (unsigned long long)metrics_.splice_bytes.load());
     out.append(wbuf);
+  }
+  {
+    // storage-fault plane vitals (schema v3) — degraded-mode state,
+    // quarantine count, scrubber knobs+progress; mirrors the Python
+    // statusz "storage" section
+    int64_t q = 0, so = 0, sb = 0, sm = 0;
+    if (store_) {
+      q = store_->quarantined_total();
+      so = store_->scrub_objects_total();
+      sb = store_->scrub_bytes_total();
+      sm = store_->scrub_mismatch_total();
+    }
+    char sbuf[448];
+    ::snprintf(sbuf, sizeof sbuf,
+               "\"storage\":{\"degraded\":%s,\"degraded_entries\":%llu,"
+               "\"degraded_since\":%lld,\"reprobe_secs\":%d,"
+               "\"quarantined_total\":%lld,"
+               "\"scrub\":{\"interval_secs\":%d,\"rate_mb_s\":%d,"
+               "\"objects_total\":%lld,\"bytes_total\":%lld,"
+               "\"mismatch_total\":%lld}},",
+               store_degraded_.load(std::memory_order_relaxed) ? "true"
+                                                               : "false",
+               (unsigned long long)degraded_entries_.load(),
+               (long long)degraded_since_wall_.load(), reprobe_secs_,
+               (long long)q, scrub_interval_secs_, scrub_rate_mb_s_,
+               (long long)so, (long long)sb, (long long)sm);
+    out.append(sbuf);
   }
   out.append("\"metrics\":");
   out.append(metrics_json());
@@ -3964,6 +4135,15 @@ int Proxy::start() {
   write_min_bps_ = env_pos_int("DEMODEL_PROXY_WRITE_MIN_BPS", 1 << 30);
   if (write_min_bps_ <= 0) write_min_bps_ = 0;  // unset → watermark off
   ktls_enabled_ = env_ktls_on();
+  // storage-fault plane knobs (names shared with the Python tier — the
+  // surface-parity analyzer keeps them in lockstep): degraded-mode
+  // re-probe cadence, and the background scrubber's interval (0 = off,
+  // the unset default) and per-second re-digest rate
+  reprobe_secs_ = env_pos_int("DEMODEL_STORE_REPROBE_SECS", 3600);
+  if (reprobe_secs_ == 0) reprobe_secs_ = 10;
+  scrub_interval_secs_ = env_pos_int("DEMODEL_SCRUB_INTERVAL_SECS", 86400);
+  scrub_rate_mb_s_ = env_pos_int("DEMODEL_SCRUB_RATE_MB_S", 4096);
+  if (scrub_rate_mb_s_ == 0) scrub_rate_mb_s_ = 8;
 
   if (reactor_enabled_) {
     epoll_fd_ = ::epoll_create1(EPOLL_CLOEXEC);
@@ -4033,6 +4213,9 @@ int Proxy::start() {
         reject_overflow(cfd);
     }
   });
+  // storage maintenance (degraded-mode re-probe + background scrubber):
+  // a 1 Hz ticker thread, woken early by stop()
+  if (store_) storage_thread_ = std::thread([this] { storage_loop(); });
   // the sampler starts LAST and stop() joins it FIRST: while it runs,
   // every registered slot's pthread_t belongs to a live serve thread
   if (env_obs_on()) {
@@ -4052,6 +4235,11 @@ void Proxy::stop() {
   }
   profile_wake_cv_.notify_all();
   if (profile_thread_.joinable()) profile_thread_.join();
+  {
+    std::lock_guard<std::mutex> g(storage_wake_mu_);
+  }
+  storage_wake_cv_.notify_all();
+  if (storage_thread_.joinable()) storage_thread_.join();
   // shutdown (not close/assign) first: the accept thread still reads
   // listen_fd_; mutate it only after the join
   int fd = listen_fd_;

@@ -174,6 +174,10 @@ struct Metrics {
   std::atomic<uint64_t> conns_writing{0}, tunnels_spliced{0},
       write_stall_evictions{0}, sendfile_bytes{0}, ktls_sends{0},
       splice_bytes{0};
+  // storage-fault plane: store_degraded is a 0/1 gauge (the node is in
+  // degraded read-through mode), refreshed at scrape time like the
+  // pool gauges above
+  std::atomic<uint64_t> store_degraded{0};
   std::string json() const;
 };
 
@@ -293,6 +297,14 @@ class Proxy {
   // redirect target lets the next fresh-signature URL dedup by content
   // rate-limited size-cap enforcement (runs store_->gc)
   void maybe_gc();
+
+  // storage-fault plane (ISSUE 19): true while the node is in degraded
+  // read-through mode — misses stream upstream → client without landing
+  // bytes; the storage maintenance thread re-probes and exits the mode
+  // automatically once the disk accepts writes again
+  bool storage_degraded() const {
+    return store_degraded_.load(std::memory_order_relaxed);
+  }
 
   // native restore data plane: "model/tensor" → byte window
   void register_tensor(const std::string &model_tensor, TensorLoc loc);
@@ -468,6 +480,33 @@ class Proxy {
   // demodel: allow(native-lock-order, surface-parity) — unrankable cv partner, leaf-only
   std::mutex profile_wake_mu_;
   std::condition_variable profile_wake_cv_;
+
+  // storage-fault plane (the native half of tier.py's degraded mode).
+  // ENOSPC on a cache-landing write triggers one emergency gc + retry;
+  // if the disk is still full the flag flips and every fill path is
+  // vetoed — requests keep streaming upstream → client, uncached. A
+  // dedicated maintenance thread re-probes the store (a real write
+  // through the Writer path, so injected faults are honored) every
+  // reprobe_secs_ and clears the flag, and runs the background scrubber
+  // in rate-limited slices when DEMODEL_SCRUB_INTERVAL_SECS > 0.
+  void enter_degraded(int err);
+  bool probe_store_writable();
+  void storage_loop();
+  // serve-path EIO on a committed object: quarantine it (namespace move
+  // + cache invalidation, Store::quarantine) so the next request is a
+  // clean miss instead of the same failing read forever
+  void note_store_read_error(const std::string &key, int64_t rc);
+  std::atomic<bool> store_degraded_{false};
+  std::atomic<uint64_t> degraded_entries_{0};
+  std::atomic<int64_t> degraded_since_wall_{0};  // entry time (unix secs)
+  int reprobe_secs_ = 10;        // DEMODEL_STORE_REPROBE_SECS (start())
+  int scrub_interval_secs_ = 0;  // DEMODEL_SCRUB_INTERVAL_SECS (start())
+  int scrub_rate_mb_s_ = 8;      // DEMODEL_SCRUB_RATE_MB_S (start())
+  std::thread storage_thread_;
+  // same unrankable-cv-partner shape as profile_wake_mu_ above
+  // demodel: allow(native-lock-order, surface-parity) — unrankable cv partner, leaf-only
+  std::mutex storage_wake_mu_;
+  std::condition_variable storage_wake_cv_;
 };
 
 }  // namespace dm

@@ -12,6 +12,7 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -1735,6 +1736,166 @@ static void test_writer_stop_inflight(const std::string &root) {
   ::close(lfd);
 }
 
+// ------------------------------------------------- storage-fault plane
+
+static void test_store_fault_injection(const std::string &root) {
+#ifndef DM_STORE_FAULT_INJECT
+  (void)root;
+#else
+  std::string err;
+  dm::Store *s = dm::Store::open(root + "/fault", &err);
+  CHECK(s != nullptr, "open fault");
+  // ENOSPC at byte 100: the first append past it fails, the writer's
+  // file state is restored, and the SAME append succeeds once space
+  // "frees" — no duplicated prefix, so the digest stays honest
+  ::setenv("DEMODEL_STORE_FAULT", "enospc@100x1", 1);
+  dm::Writer *w = s->begin("aaaa1111aaaa1111", false, &err);
+  CHECK(w != nullptr, "begin fault");
+  std::string body(400, 'z');
+  CHECK(w->append(body.data(), (int64_t)body.size()) == -ENOSPC,
+        "enospc fires");
+  ::unsetenv("DEMODEL_STORE_FAULT");
+  CHECK(w->append(body.data(), (int64_t)body.size()) == 0, "retry lands");
+  CHECK(w->commit("{}") == 0, "commit after retry");
+  delete w;
+  CHECK(s->size("aaaa1111aaaa1111") == 400, "no duplicated prefix");
+  std::vector<char> rb(400);
+  CHECK(s->pread("aaaa1111aaaa1111", rb.data(), 400, 0) == 400, "read back");
+  CHECK(::memcmp(rb.data(), body.data(), 400) == 0, "bytes exact");
+  // EIO on read: one poisoned pread, then the path heals
+  ::setenv("DEMODEL_STORE_FAULT", "eio-readx1", 1);
+  CHECK(s->pread("aaaa1111aaaa1111", rb.data(), 400, 0) == -EIO, "eio-read");
+  ::unsetenv("DEMODEL_STORE_FAULT");
+  CHECK(s->pread("aaaa1111aaaa1111", rb.data(), 400, 0) == 400, "read heals");
+  // EIO on write: the fill aborts cleanly (no retry contract for EIO)
+  ::setenv("DEMODEL_STORE_FAULT", "eio-write", 1);
+  dm::Writer *w2 = s->begin("bbbb1111bbbb1111", false, &err);
+  CHECK(w2 != nullptr, "begin eio");
+  CHECK(w2->append("x", 1) == -EIO, "eio-write");
+  ::unsetenv("DEMODEL_STORE_FAULT");
+  w2->abort(false);
+  delete w2;
+  CHECK(!s->has("bbbb1111bbbb1111"), "aborted fill not addressable");
+  delete s;
+#endif
+}
+
+static void test_store_quarantine(const std::string &root) {
+  std::string err;
+  dm::Store *s = dm::Store::open(root + "/quar", &err);
+  CHECK(s != nullptr, "open quar");
+  std::string body(5000, 'q');
+  char digest[65] = {0};
+  CHECK(s->put("cccc1111cccc1111", body.data(), (int64_t)body.size(), "{}",
+               digest) == 0, "put quar");
+  CHECK(s->quarantine("cccc1111cccc1111") == 0, "quarantine");
+  CHECK(!s->has("cccc1111cccc1111"), "quarantined not addressable");
+  CHECK(!s->has_digest(digest), "digest link dropped");
+  struct stat st;
+  CHECK(::stat((root + "/quar/quarantine/cccc1111cccc1111").c_str(), &st)
+            == 0, "bytes preserved for forensics");
+  CHECK(s->quarantined_total() == 1, "quarantine counter");
+  CHECK(s->quarantine("cccc1111cccc1111") == -ENOENT, "double quarantine");
+  CHECK(s->quarantined_total() == 1, "double does not double-count");
+  // the key is reusable: a clean re-fill replaces the quarantined body
+  CHECK(s->put("cccc1111cccc1111", body.data(), (int64_t)body.size(), "{}",
+               nullptr) == 0, "refill");
+  CHECK(s->has("cccc1111cccc1111"), "refilled");
+  delete s;
+}
+
+static void test_store_scrub(const std::string &root) {
+  std::string err;
+  dm::Store *s = dm::Store::open(root + "/scrub", &err);
+  CHECK(s != nullptr, "open scrub");
+  std::string good(70000, 'g'), bad(70000, 'b');
+  CHECK(s->put("dddd1111dddd1111", good.data(), (int64_t)good.size(), "{}",
+               nullptr) == 0, "put good");
+  CHECK(s->put("eeee1111eeee1111", bad.data(), (int64_t)bad.size(), "{}",
+               nullptr) == 0, "put bad");
+  // flip one byte behind the store's back — silent bit-rot
+  int fd = ::open((root + "/scrub/objects/eeee1111eeee1111").c_str(),
+                  O_WRONLY | O_CLOEXEC);
+  CHECK(fd >= 0, "open victim");
+  CHECK(::pwrite(fd, "X", 1, 12345) == 1, "flip byte");
+  ::close(fd);
+  int64_t objs = 0, bytes = 0;
+  int bad_n = 0;
+  CHECK(s->scrub_pass(1 << 30, &objs, &bytes, &bad_n) == 1, "full pass");
+  CHECK(objs == 2, "both objects visited");
+  CHECK(bytes == 140000, "bytes hashed");
+  CHECK(bad_n == 1, "one mismatch");
+  CHECK(!s->has("eeee1111eeee1111"), "corrupt key quarantined");
+  CHECK(s->has("dddd1111dddd1111"), "intact key untouched");
+  CHECK(s->scrub_mismatch_total() == 1, "mismatch counter");
+  // bounded slice: a tiny budget stops mid-pass, the cursor resumes
+  CHECK(s->put("ffff1111ffff1111", good.data(), (int64_t)good.size(), "{}",
+               nullptr) == 0, "put third");
+  CHECK(s->scrub_pass(1, &objs, &bytes, &bad_n) == 0, "budget stops slice");
+  int wrapped = 0;
+  for (int i = 0; i < 4 && wrapped != 1; i++)
+    wrapped = s->scrub_pass(1 << 30, &objs, &bytes, &bad_n);
+  CHECK(wrapped == 1, "cursor wraps");
+  delete s;
+}
+
+static void test_store_recover(const std::string &root) {
+  std::string err;
+  std::string dir = root + "/recov";
+  {
+    dm::Store *s = dm::Store::open(dir, &err);
+    CHECK(s != nullptr, "open recov");
+    // writer A: 300 bytes landed, durable watermark checkpointed at 200
+    // — a crash-shaped abort(keep) leaves partial + sidecar behind
+    dm::Writer *w = s->begin("abcd2222abcd2222", false, &err);
+    CHECK(w != nullptr, "begin recov");
+    std::string chunk(300, 'r');
+    CHECK(w->append(chunk.data(), 300) == 0, "append recov");
+    w->abort(true);
+    delete w;
+    // the sidecar the Python tier leader's checkpoint() would have
+    // written at watermark 200 (offset is a JSON *string* by contract)
+    int sfd = ::open((dir + "/partial/abcd2222abcd2222.progress").c_str(),
+                     O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+    CHECK(sfd >= 0, "sidecar open");
+    const char *doc = "{\"offset\": \"200\", \"sha256\": \"\"}";
+    CHECK(::write(sfd, doc, ::strlen(doc)) ==
+              (ssize_t)::strlen(doc), "sidecar write");
+    ::close(sfd);
+    // writer B: torn partial, no sidecar — unrecoverable
+    dm::Writer *w2 = s->begin("beef2222beef2222", false, &err);
+    CHECK(w2 != nullptr, "begin torn");
+    CHECK(w2->append(chunk.data(), 100) == 0, "append torn");
+    w2->abort(true);
+    delete w2;
+    delete s;
+  }
+  // next incarnation: open()'s sweep uses the 60 s grace (both partials
+  // are fresh, so it must skip them); an explicit grace-0 sweep then
+  // resumes A at its watermark and purges torn B
+  dm::Store *s = dm::Store::open(dir, &err);
+  CHECK(s != nullptr, "reopen recov");
+  CHECK(s->partial_size("abcd2222abcd2222") == 300, "grace shields fresh");
+  int resumed = 0, purged = 0;
+  s->recover(0.0, &resumed, &purged);
+  CHECK(resumed == 1, "one resumable partial");
+  CHECK(purged == 1, "torn partial purged");
+  CHECK(s->partial_size("abcd2222abcd2222") == 200,
+        "truncated to durable watermark");
+  CHECK(s->partial_size("beef2222beef2222") == 0, "torn gone");
+  // resume from the watermark and finish the fill — the landed prefix
+  // never re-crosses the wire
+  dm::Writer *w = s->begin("abcd2222abcd2222", true, &err);
+  CHECK(w != nullptr, "resume begin");
+  CHECK(w->offset() == 200, "resume offset == durable watermark");
+  std::string tail(50, 't');
+  CHECK(w->append(tail.data(), 50) == 0, "tail append");
+  CHECK(w->commit("{}") == 0, "resumed commit");
+  delete w;
+  CHECK(s->size("abcd2222abcd2222") == 250, "final size");
+  delete s;
+}
+
 int main() {
   // the data plane's raw sends carry MSG_NOSIGNAL, but OpenSSL's socket
   // BIO does not — a peer-closed TLS conn must surface as EPIPE/CHECK
@@ -1746,6 +1907,10 @@ int main() {
   test_store_basic(root);
   test_store_concurrent(root);
   test_store_gc_pin_stress(root);
+  test_store_fault_injection(root);
+  test_store_quarantine(root);
+  test_store_scrub(root);
+  test_store_recover(root);
   test_proxy_lifecycle(root);
   test_session_pool(root);
   test_idle_timeout(root, /*reactor=*/false);

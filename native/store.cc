@@ -10,6 +10,7 @@
 #include <cerrno>
 #include <csignal>
 #include <cstdio>
+#include <ctime>
 #include <cstdlib>
 #include <cstring>
 #include <dirent.h>
@@ -40,6 +41,71 @@ static bool is_hex_digest(const std::string &d) {
 std::string key_for_uri(const std::string &uri) {
   return Sha256::hex_of(uri.data(), uri.size()).substr(0, 16);
 }
+
+#ifdef DM_STORE_FAULT_INJECT
+// Test-only disk-fault twin (compiled into the selftest builds only):
+// DEMODEL_STORE_FAULT programs a deterministic storage fault, mirroring
+// the Python store layer's tests/chaosdisk.py hook. Grammar:
+//   enospc[@BYTE][xN]   append fails -ENOSPC once offset+len > BYTE
+//   eio-write[xN]       append fails -EIO
+//   eio-read[xN]        pread fails -EIO
+// The optional xN suffix bounds how many times the fault fires; the env
+// var is re-read per call so a selftest scenario can re-program or clear
+// it mid-run.
+namespace {
+struct FaultState {
+  // selftest-only leaf mutex, never held across another lock or syscall
+  // demodel: allow(native-lock-order, surface-parity) — test-only twin
+  std::mutex mu;
+  std::string spec;
+  int kind = 0;        // 0 none, 1 enospc, 2 eio-write, 3 eio-read
+  long long at = -1;   // enospc byte threshold (-1: immediately)
+  long long left = -1; // remaining firings (-1: unlimited)
+};
+
+FaultState &fault_state() {
+  static FaultState s;
+  return s;
+}
+
+int fault_rc(bool is_write, int64_t off, int64_t len) {
+  // NOLINTNEXTLINE(concurrency-mt-unsafe) — test-only twin; selftest
+  // scenarios setenv between phases, never concurrently with I/O
+  const char *env = ::getenv("DEMODEL_STORE_FAULT");
+  if (!env || !*env) return 0;
+  FaultState &s = fault_state();
+  std::lock_guard<std::mutex> g(s.mu);
+  if (s.spec != env) {
+    s.spec = env;
+    s.kind = 0;
+    s.at = -1;
+    s.left = -1;
+    std::string v = s.spec;
+    auto xpos = v.rfind('x');
+    if (xpos != std::string::npos && xpos + 1 < v.size() &&
+        v[xpos + 1] >= '0' && v[xpos + 1] <= '9') {
+      s.left = ::strtoll(v.c_str() + xpos + 1, nullptr, 10);
+      v = v.substr(0, xpos);
+    }
+    auto apos = v.find('@');
+    if (apos != std::string::npos) {
+      s.at = ::strtoll(v.c_str() + apos + 1, nullptr, 10);
+      v = v.substr(0, apos);
+    }
+    if (v == "enospc") s.kind = 1;
+    else if (v == "eio-write") s.kind = 2;
+    else if (v == "eio-read") s.kind = 3;
+  }
+  if (s.kind == 0 || s.left == 0) return 0;
+  int rc = 0;
+  if (is_write && s.kind == 1 && (s.at < 0 || off + len > s.at)) rc = -ENOSPC;
+  else if (is_write && s.kind == 2) rc = -EIO;
+  else if (!is_write && s.kind == 3) rc = -EIO;
+  if (rc != 0 && s.left > 0) s.left--;
+  return rc;
+}
+}  // namespace
+#endif  // DM_STORE_FAULT_INJECT
 
 std::string meta_scan(const std::string &meta, const char *name) {
   std::string pat = std::string("\"") + name + "\":";
@@ -78,13 +144,22 @@ Writer::~Writer() {
 }
 
 int Writer::append(const void *buf, int64_t len) {
+#ifdef DM_STORE_FAULT_INJECT
+  if (int frc = fault_rc(true, offset_, len)) return frc;
+#endif
   const char *p = static_cast<const char *>(buf);
   int64_t left = len;
   while (left > 0) {
     ssize_t n = ::write(fd_, p, static_cast<size_t>(left));
     if (n < 0) {
       if (errno == EINTR) continue;
-      return -errno;
+      int rc = -errno;
+      // restore the pre-append file state (a short write may have landed
+      // some bytes): callers retry the SAME append after an emergency gc
+      // frees space, and a duplicated prefix would poison the digest
+      ::ftruncate(fd_, offset_);
+      ::lseek(fd_, offset_, SEEK_SET);
+      return rc;
     }
     p += n;
     left -= n;
@@ -111,7 +186,10 @@ int Writer::abort(bool keep_partial) {
   if (done_) return -EINVAL;
   if (fd_ >= 0) ::close(fd_);
   fd_ = -1;
-  if (!keep_partial) ::unlink(store_->part_path(key_).c_str());
+  if (!keep_partial) {
+    ::unlink(store_->part_path(key_).c_str());
+    ::unlink((store_->part_path(key_) + ".progress").c_str());
+  }
   done_ = true;
   store_->finish_writer(key_);
   return 0;
@@ -298,7 +376,8 @@ static bool pin_marker_live(const std::string &path, long pid) {
 }
 
 Store *Store::open(const std::string &root, std::string *err) {
-  for (const char *sub : {"", "/objects", "/partial", "/digests", "/pins"}) {
+  for (const char *sub :
+       {"", "/objects", "/partial", "/digests", "/pins", "/quarantine"}) {
     std::string p = root + sub;
     // create parents of root lazily too (cache_dir may not exist yet)
     if (sub[0] == 0) {
@@ -328,6 +407,10 @@ Store *Store::open(const std::string &root, std::string *err) {
     if (end && *end == '\0') mb = v < 0 ? 0 : v;
   }
   s->hot_max_ = mb << 20;
+  // crash-recovery sweep: reap torn/orphaned partials from a previous
+  // incarnation, truncate checkpointed ones to their durable watermark.
+  // The 60 s grace keeps a sibling handle's live fills out of reach.
+  s->recover_at_open(60.0);
   return s;
 }
 
@@ -362,6 +445,9 @@ std::string Store::part_path(const std::string &key) const {
 }
 std::string Store::digest_path(const std::string &digest) const {
   return root_ + "/digests/" + digest;
+}
+std::string Store::quarantine_path(const std::string &key) const {
+  return root_ + "/quarantine/" + key;
 }
 
 bool Store::has(const std::string &key) {
@@ -410,6 +496,12 @@ int64_t Store::pread(const std::string &key, void *buf, int64_t len, int64_t off
   if (!is_safe_key(key)) return -EINVAL;
   int fd = open_read_fd(key);
   if (fd < 0) return -ENOENT;
+#ifdef DM_STORE_FAULT_INJECT
+  if (int frc = fault_rc(false, off, len)) {
+    ::close(fd);
+    return frc;
+  }
+#endif
   char *p = static_cast<char *>(buf);
   int64_t got = 0;
   while (got < len) {
@@ -555,8 +647,20 @@ void Store::drop_digest_ref(const std::string &key, const std::string &old_meta)
 
 int Store::publish(const std::string &key, const std::string &meta_json,
                    const std::string &digest) {
-  // meta sidecar first (tmp+rename), then body rename — a reader that sees
-  // the object always finds its meta
+  // Commit-path durability order (the crash-recovery contract — each
+  // step is individually atomic, so a crash between any two leaves the
+  // store consistent):
+  //   1. body bytes fsync'd into partial/<key> (Writer::commit /
+  //      RangeWriter::commit do this before calling publish)
+  //   2. meta sidecar: write <key>.meta.tmp, fsync, rename over
+  //      <key>.meta — the sidecar is durable BEFORE the object becomes
+  //      addressable, so a reader that sees the object always finds its
+  //      meta (and its content address, which the scrubber and the hot
+  //      tier verify against)
+  //   3. rename(partial/<key> → objects/<key>) — the publish point; a
+  //      crash before it leaves a resumable partial, never a torn object
+  //   4. cache invalidations + digests/ hardlink + index invalidation —
+  //      all reconstructible from objects/ after a crash
   std::string old_meta = meta(key);
   std::string mtmp = meta_path(key) + ".tmp";
   int mfd = ::open(mtmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
@@ -582,6 +686,9 @@ int Store::publish(const std::string &key, const std::string &meta_json,
   if (::rename(mtmp.c_str(), meta_path(key).c_str()) != 0) return -errno;
   if (!old_meta.empty()) drop_digest_ref(key, old_meta);
   if (::rename(part_path(key).c_str(), obj_path(key).c_str()) != 0) return -errno;
+  // the partial is gone: its progress checkpoint (if the tier leader
+  // wrote one) is now an orphan
+  ::unlink((part_path(key) + ".progress").c_str());
   {
     // recommit under the same key: retire any stale cached fd
     std::lock_guard<Mutex> g(fd_mu_);
@@ -627,6 +734,7 @@ int Store::remove(const std::string &key) {
   if (::unlink(obj_path(key).c_str()) != 0 && errno != ENOENT) rc = -errno;
   ::unlink(meta_path(key).c_str());
   ::unlink(part_path(key).c_str());
+  ::unlink((part_path(key) + ".progress").c_str());
   {
     std::lock_guard<Mutex> g(fd_mu_);
     auto it = fd_cache_.find(key);
@@ -824,6 +932,202 @@ int64_t Store::gc(int64_t max_bytes, int64_t *freed_bytes,
   }
   invalidate_index();
   return total;
+}
+
+// ----------------------------------------------------- storage-fault plane
+
+int Store::quarantine(const std::string &key) {
+  if (!is_safe_key(key)) return -EINVAL;
+  mkdir_p(root_ + "/quarantine");  // tolerate pre-plane roots
+  std::string old_meta = meta(key);
+  if (!old_meta.empty()) drop_digest_ref(key, old_meta);
+  int rc = 0;
+  if (::rename(obj_path(key).c_str(), quarantine_path(key).c_str()) != 0) {
+    rc = -errno;
+    // rename can only fail same-filesystem for exotic reasons; whatever
+    // happened, the untrusted bytes must leave the addressable namespace
+    if (rc != -ENOENT) ::unlink(obj_path(key).c_str());
+  }
+  ::rename(meta_path(key).c_str(), (quarantine_path(key) + ".meta").c_str());
+  {
+    std::lock_guard<Mutex> g(fd_mu_);
+    auto it = fd_cache_.find(key);
+    if (it != fd_cache_.end()) {
+      ::close(it->second);
+      fd_cache_.erase(it);
+    }
+  }
+  hot_invalidate(key);
+  invalidate_index();
+  if (rc == 0) quarantined_total_++;
+  return rc;
+}
+
+void Store::recover(double grace_secs, int *resumed_out, int *purged_out) {
+  std::set<std::string> active;
+  {
+    std::lock_guard<Mutex> g(writers_mu_);
+    active = active_writers_;
+  }
+  recover_impl(grace_secs, active, resumed_out, purged_out);
+}
+
+void Store::recover_at_open(double grace_secs) {
+  // pre-return handle: no writer can exist yet, sweep lock-free
+  recover_impl(grace_secs, std::set<std::string>(), nullptr, nullptr);
+}
+
+void Store::recover_impl(double grace_secs,
+                         const std::set<std::string> &active,
+                         int *resumed_out, int *purged_out) {
+  if (resumed_out) *resumed_out = 0;
+  if (purged_out) *purged_out = 0;
+  int64_t now = static_cast<int64_t>(::time(nullptr));
+  std::string pdir = root_ + "/partial";
+  std::vector<std::string> names;
+  DIR *d = ::opendir(pdir.c_str());
+  if (!d) return;
+  struct dirent *e;
+  while ((e = ::readdir(d)) != nullptr) {
+    std::string n = e->d_name;
+    if (n != "." && n != "..") names.push_back(n);
+  }
+  ::closedir(d);
+  auto is_suffix = [](const std::string &n, const char *suf) {
+    size_t l = ::strlen(suf);
+    return n.size() > l && n.compare(n.size() - l, l, suf) == 0;
+  };
+  auto older_than_grace = [&](const std::string &path) {
+    struct stat st;
+    if (::stat(path.c_str(), &st) != 0) return false;
+    return static_cast<double>(now - st.st_mtime) >= grace_secs;
+  };
+  for (const std::string &n : names) {
+    std::string path = pdir + "/" + n;
+    if (is_suffix(n, ".progress")) {
+      // orphan sidecar (its partial was committed or purged)
+      std::string key = n.substr(0, n.size() - 9);
+      struct stat st;
+      if (::stat((pdir + "/" + key).c_str(), &st) != 0 &&
+          older_than_grace(path))
+        ::unlink(path.c_str());
+      continue;
+    }
+    if (is_suffix(n, ".tmp")) {  // no writer produces these; stale droppings
+      if (older_than_grace(path)) ::unlink(path.c_str());
+      continue;
+    }
+    if (active.count(n)) continue;
+    struct stat st;
+    if (::stat(path.c_str(), &st) != 0) continue;
+    if (static_cast<double>(now - st.st_mtime) < grace_secs) continue;
+    // the sidecar is the resumability proof: a durable watermark the
+    // tier leader fsync'd before recording (see StoreWriter.checkpoint)
+    std::string side = path + ".progress";
+    std::string body;
+    int sfd = ::open(side.c_str(), O_RDONLY | O_CLOEXEC);
+    if (sfd >= 0) {
+      char buf[512];
+      ssize_t rn;
+      while ((rn = ::read(sfd, buf, sizeof buf)) > 0)
+        body.append(buf, static_cast<size_t>(rn));
+      ::close(sfd);
+    }
+    std::string off_s = meta_scan(body, "offset");
+    long long off = off_s.empty() ? -1 : ::strtoll(off_s.c_str(), nullptr, 10);
+    if (off > 0 && off <= static_cast<long long>(st.st_size)) {
+      // bytes past the durable watermark may be torn (written but never
+      // fsync'd before the crash) — drop them; the digest state recovers
+      // by rehash at the next begin(resume=true)
+      if (static_cast<long long>(st.st_size) > off)
+        (void)::truncate(path.c_str(), static_cast<off_t>(off));
+      if (resumed_out) (*resumed_out)++;
+    } else {
+      ::unlink(path.c_str());
+      ::unlink(side.c_str());
+      if (purged_out) (*purged_out)++;
+    }
+  }
+  // stale commit droppings in objects/: <key>.meta.tmp from a crash
+  // between meta write and rename, <key>.lnk from a torn materialize
+  std::string odir = root_ + "/objects";
+  d = ::opendir(odir.c_str());
+  if (!d) return;
+  while ((e = ::readdir(d)) != nullptr) {
+    std::string n = e->d_name;
+    if (!is_suffix(n, ".tmp") && !is_suffix(n, ".lnk")) continue;
+    std::string path = odir + "/" + n;
+    if (older_than_grace(path)) ::unlink(path.c_str());
+  }
+  ::closedir(d);
+}
+
+int Store::scrub_pass(int64_t max_bytes, int64_t *objects_out,
+                      int64_t *bytes_out, int *mismatched_out) {
+  if (objects_out) *objects_out = 0;
+  if (bytes_out) *bytes_out = 0;
+  if (mismatched_out) *mismatched_out = 0;
+  std::vector<std::string> keys;
+  {
+    DIR *d = ::opendir((root_ + "/objects").c_str());
+    if (!d) return 0;
+    struct dirent *e;
+    while ((e = ::readdir(d)) != nullptr) {
+      std::string n = e->d_name;
+      if (n == "." || n == "..") continue;
+      if (n.size() > 5 && n.compare(n.size() - 5, 5, ".meta") == 0) continue;
+      if (n.size() > 4 && (n.compare(n.size() - 4, 4, ".tmp") == 0 ||
+                           n.compare(n.size() - 4, 4, ".lnk") == 0))
+        continue;
+      keys.push_back(n);
+    }
+    ::closedir(d);
+  }
+  std::sort(keys.begin(), keys.end());
+  std::lock_guard<Mutex> g(gc_mu_);  // one maintenance pass at a time
+  auto it = keys.begin();
+  if (!scrub_cursor_.empty())
+    it = std::upper_bound(keys.begin(), keys.end(), scrub_cursor_);
+  int64_t budget = max_bytes;
+  std::vector<char> buf(1 << 20);
+  for (; it != keys.end(); ++it) {
+    if (budget <= 0) {
+      scrub_cursor_ = it == keys.begin() ? "" : *std::prev(it);
+      return 0;
+    }
+    const std::string &key = *it;
+    {
+      std::lock_guard<Mutex> wg(writers_mu_);
+      if (active_writers_.count(key)) continue;
+    }
+    std::string want = meta_digest(meta(key));
+    scrub_objects_total_++;
+    if (objects_out) (*objects_out)++;
+    if (want.empty()) continue;  // no recorded content address to check
+    int fd = ::open(obj_path(key).c_str(), O_RDONLY | O_CLOEXEC);
+    if (fd < 0) continue;
+    Sha256 sha;
+    ssize_t n;
+    int64_t seen = 0;
+    bool read_err = false;
+    while ((n = ::read(fd, buf.data(), buf.size())) > 0) {
+      sha.update(buf.data(), static_cast<size_t>(n));
+      seen += n;
+    }
+    if (n < 0) read_err = true;
+    ::close(fd);
+    budget -= seen;
+    scrub_bytes_total_ += seen;
+    if (bytes_out) (*bytes_out) += seen;
+    if (read_err || sha.hex() != want) {
+      // bit-rot (or an unreadable sector): out of the namespace it goes
+      quarantine(key);
+      scrub_mismatch_total_++;
+      if (mismatched_out) (*mismatched_out)++;
+    }
+  }
+  scrub_cursor_.clear();
+  return 1;
 }
 
 // --------------------------------------------------------- mmap hot tier
@@ -1261,6 +1565,33 @@ void dm_store_unpin(void *h, const char *key) {
 
 int64_t dm_store_evictions(void *h) {
   return static_cast<dm::Store *>(h)->evictions_total();
+}
+
+// -- storage-fault plane
+
+int dm_store_quarantine(void *h, const char *key) {
+  return static_cast<dm::Store *>(h)->quarantine(key ? key : "");
+}
+
+void dm_store_recover(void *h, double grace_secs, int *resumed, int *purged) {
+  static_cast<dm::Store *>(h)->recover(grace_secs, resumed, purged);
+}
+
+int dm_store_scrub(void *h, int64_t max_bytes, int64_t *objects,
+                   int64_t *bytes, int *mismatched) {
+  return static_cast<dm::Store *>(h)->scrub_pass(max_bytes, objects, bytes,
+                                                 mismatched);
+}
+
+// out[4]: quarantined_total, scrub_objects_total, scrub_bytes_total,
+// scrub_mismatch_total — one call for the Python metrics bridge
+void dm_store_storage_stats(void *h, int64_t *out4) {
+  auto *s = static_cast<dm::Store *>(h);
+  if (!out4) return;
+  out4[0] = s->quarantined_total();
+  out4[1] = s->scrub_objects_total();
+  out4[2] = s->scrub_bytes_total();
+  out4[3] = s->scrub_mismatch_total();
 }
 
 void dm_rw_abort(void *w, int keep_partial) {

@@ -155,6 +155,44 @@ class Store {
   int materialize(const std::string &key, const std::string &digest,
                   const std::string &meta_json);
 
+  // -- storage-fault plane ---------------------------------------------
+  // Move a committed object whose bytes can no longer be trusted (EIO on
+  // read, digest mismatch) into quarantine/ — out of the addressable
+  // namespace but preserved for forensics. Drops the digest hardlink and
+  // invalidates the fd cache + hot tier, so the next read is a clean
+  // miss that re-enters the normal fill path. Returns 0 or -errno
+  // (-ENOENT when the object is already gone).
+  int quarantine(const std::string &key);
+  int64_t quarantined_total() const { return quarantined_total_; }
+
+  // Crash-recovery sweep over partial/ (called by open() with the
+  // default grace). Partials older than grace_secs carrying a
+  // `.progress` sidecar (a durable watermark the Python tier leader
+  // checkpoints) are truncated to that watermark and kept — the next
+  // single-flight leader resumes from it, so the landed prefix never
+  // re-crosses the wire. Partials without a sidecar are torn/orphaned
+  // and unlinked, as are stale `.tmp`/`.lnk` droppings in objects/.
+  // The grace window protects live writers in sibling handles (their
+  // partials have fresh mtimes); this handle's active writers are
+  // always skipped.
+  void recover(double grace_secs, int *resumed_out, int *purged_out);
+
+  // The open-time variant: a handle that has not been returned yet can
+  // have no active writers, so the sweep runs without touching
+  // writers_mu_ (keeps open() off the lock-order graph entirely).
+  void recover_at_open(double grace_secs);
+
+  // One bounded scrubber slice: re-hash up to max_bytes of committed
+  // objects (resuming from an internal cursor) against their recorded
+  // content address, quarantining mismatches. Returns 1 when the slice
+  // completed a full pass over objects/ (cursor wrapped), else 0.
+  // Objects whose meta records no sha256 are counted but not hashed.
+  int scrub_pass(int64_t max_bytes, int64_t *objects_out,
+                 int64_t *bytes_out, int *mismatched_out);
+  int64_t scrub_objects_total() const { return scrub_objects_total_; }
+  int64_t scrub_bytes_total() const { return scrub_bytes_total_; }
+  int64_t scrub_mismatch_total() const { return scrub_mismatch_total_; }
+
   // Size-capped LRU garbage collection over objects/ (neither reference
   // generation had one — a pod-host cache that can only grow is not
   // operable). Evicts least-recently-used committed objects (recency =
@@ -192,6 +230,7 @@ class Store {
   std::string meta_path(const std::string &key) const;
   std::string part_path(const std::string &key) const;
   std::string digest_path(const std::string &digest) const;
+  std::string quarantine_path(const std::string &key) const;
 
   // -- meta helpers
   static bool meta_is_private(const std::string &meta_json);
@@ -214,6 +253,10 @@ class Store {
   // over one root, each with its own in-memory refcounts); reaps
   // markers whose pid is gone so a crashed server can't pin forever
   std::set<std::string> foreign_pins();
+  // shared recover sweep; `active` is a pre-snapshotted writer set so
+  // the sweep itself holds no lock (open() passes the empty set)
+  void recover_impl(double grace_secs, const std::set<std::string> &active,
+                    int *resumed_out, int *purged_out);
 
   std::string root_;
 
@@ -232,8 +275,13 @@ class Store {
   std::string index_cache_;
   int64_t index_mtime_ns_ = -1;  // objects/ dir mtime when cache was built
 
-  Mutex gc_mu_{kRankStoreGc};  // one GC pass at a time
+  Mutex gc_mu_{kRankStoreGc};  // one GC (or scrub) pass at a time
+  std::string scrub_cursor_;   // last scrubbed key, guarded by gc_mu_
   std::atomic<int64_t> evictions_total_{0};
+  std::atomic<int64_t> quarantined_total_{0};
+  std::atomic<int64_t> scrub_objects_total_{0};
+  std::atomic<int64_t> scrub_bytes_total_{0};
+  std::atomic<int64_t> scrub_mismatch_total_{0};
 
   // mmap hot tier: key → pinned read-only mapping. `users` counts
   // in-flight serves off the mapping; `dead` marks an evicted entry

@@ -1,0 +1,140 @@
+"""Deterministic fault-injection for the disk plane.
+
+The storage twin of :mod:`tests.chaoshttp`: a seeded
+:class:`DiskFaultPlan` installs as the store's test-only fault hook
+(:func:`demodel_tpu.store.set_fault_hook`) and poisons store operations
+per declared :class:`DiskFaultSpec`\\ s:
+
+- ``enospc``: the matching write op raises ``OSError(ENOSPC)`` — with
+  ``at_byte`` set, only once the append would cross that byte (the
+  filling-disk shape: the landing stream dies mid-object, not at open);
+- ``eio-write``: the matching append raises ``OSError(EIO)`` (bad
+  sector under the partial);
+- ``eio-read``: the matching pread raises ``OSError(EIO)`` (bad sector
+  under a committed object — the quarantine trigger);
+- ``crash-at-commit``: the matching commit hard-kills the process with
+  ``os._exit`` — between the body landing and the meta/publish renames,
+  the sharpest crash shape. Only meaningful in a subprocess harness.
+
+Hook ops consulted by the store wrapper: ``append`` (offset, length),
+``commit`` (offset), ``pread`` (offset, length), ``probe`` (the
+degraded-mode exit probe — an ``enospc`` spec matching it keeps the node
+degraded until the plan is exhausted or cleared).
+
+Specs are consumed deterministically: first matching spec in declared
+order, ``times`` firings each (``-1`` = unlimited — the disk-stays-full
+shape); ``plan.injected`` records every fault that actually fired so
+tests assert the fault really happened. The native selftest binaries
+carry an equivalent twin behind ``-DDM_STORE_FAULT_INJECT``, programmed
+via ``DEMODEL_STORE_FAULT`` — same grammar, same shapes.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import threading
+from dataclasses import dataclass, replace
+from random import Random
+
+from demodel_tpu import store as store_mod
+
+KINDS = ("enospc", "eio-write", "eio-read", "crash-at-commit")
+
+#: which hook ops each kind can poison
+_OPS = {
+    "enospc": ("append", "commit", "probe"),
+    "eio-write": ("append",),
+    "eio-read": ("pread",),
+    "crash-at-commit": ("commit",),
+}
+
+
+@dataclass
+class DiskFaultSpec:
+    kind: str
+    #: substring the store key must contain ("" matches every key)
+    key: str = ""
+    #: firings before the spec goes inert; -1 = unlimited (full disk)
+    times: int = 1
+    #: enospc only: fire once offset+length crosses this byte (-1 = at
+    #: the first matching op — open-time full disk)
+    at_byte: int = -1
+    #: restrict to one hook op ("" = every op the kind can poison) —
+    #: e.g. an enospc that spares appends but kills the commit sidecar
+    op: str = ""
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown disk fault kind {self.kind!r}")
+        if self.op and self.op not in _OPS[self.kind]:
+            raise ValueError(f"op {self.op!r} not poisonable by {self.kind}")
+
+
+@dataclass
+class DiskInjection:
+    """One fault that actually fired (the proof side of the harness)."""
+
+    kind: str
+    op: str
+    key: str
+    offset: int = -1
+
+
+class DiskFaultPlan:
+    """Thread-safe, seeded, deterministic disk-fault source. Callable
+    with the store hook signature, so ``install()`` wires it straight
+    into the store layer; use as a context manager to guarantee the
+    hook is cleared even when the test dies."""
+
+    def __init__(self, *specs: DiskFaultSpec, seed: int = 0):
+        self._specs = [replace(s) for s in specs]  # private mutable copies
+        self._rng = Random(seed)  # reserved: future randomized positions
+        self._lock = threading.Lock()
+        self.injected: list[DiskInjection] = []
+
+    # -- the hook ---------------------------------------------------------
+    def __call__(self, op: str, key: str, **info) -> None:
+        offset = int(info.get("offset", -1))
+        length = int(info.get("length", 0))
+        with self._lock:
+            for s in self._specs:
+                if s.times == 0 or (s.key and s.key not in key):
+                    continue
+                if op not in _OPS[s.kind] or (s.op and op != s.op):
+                    continue
+                if (s.kind == "enospc" and op == "append" and s.at_byte >= 0
+                        and offset + length <= s.at_byte):
+                    continue
+                if s.times > 0:
+                    s.times -= 1
+                self.injected.append(DiskInjection(s.kind, op, key, offset))
+                kind = s.kind
+                break
+            else:
+                return
+        if kind == "crash-at-commit":
+            # the sharpest crash shape: body landed, publish never ran;
+            # flush nothing — a real SIGKILL wouldn't either
+            os._exit(42)
+        err = errno.ENOSPC if kind == "enospc" else errno.EIO
+        raise OSError(err, f"injected {kind} on {op} {key}")
+
+    # -- proofs -----------------------------------------------------------
+    def fired(self, kind: str) -> int:
+        with self._lock:
+            return sum(1 for i in self.injected if i.kind == kind)
+
+    # -- lifecycle --------------------------------------------------------
+    def install(self) -> "DiskFaultPlan":
+        store_mod.set_fault_hook(self)
+        return self
+
+    def uninstall(self) -> None:
+        store_mod.set_fault_hook(None)
+
+    def __enter__(self) -> "DiskFaultPlan":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

@@ -1,0 +1,79 @@
+"""Known storage-fault-plane violations; golden-tested by (rule, line).
+
+The leak shapes the obligation pass must catch on the NEW fault-path
+code: a partial writer stranded when the post-eviction ENOSPC retry
+raises, a degraded-mode probe fd lost if the probe write raises, a
+scrubber mmap dropped on the mismatch early-return, and a degraded
+relay lease never settled when the upstream dies. The controls at the
+bottom are the REAL tier idioms (handler-abort + re-publish, finally
+close, chained begin().commit()) and must stay silent.
+"""
+
+import hashlib
+import mmap
+import os
+
+
+def enospc_retry_leaks_writer(store, key, chunk, evict):
+    w = store.begin(key, resume=True)
+    try:
+        w.append(chunk)
+    except OSError:
+        evict()
+        w.append(chunk)  # retry may raise again: w never settled
+    w.commit({})
+
+
+def probe_leaks_fd(path):
+    fd = os.open(path, os.O_WRONLY)
+    os.write(fd, b"probe")  # a full disk raises here, fd leaks
+    os.fsync(fd)
+    os.close(fd)
+
+
+def scrub_slice_leaks_mmap(fd, size, want):
+    mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+    if hashlib.sha256(mm).hexdigest() != want:
+        return False  # mismatch early-return: mm never closed
+    mm.close()
+    return True
+
+
+def relay_leaks_flight(flights, key, stream):
+    flight, leader = flights.lease(key)
+    if not leader:
+        return flight.wait()
+    for chunk in stream:  # upstream raise strands the lease
+        flight.relay(chunk)
+    flight.finish(ok=True)
+    return None
+
+
+# ---- controls: the real fault-path idioms, silent -----------------------
+
+
+def commit_enospc_recovers(store, key, chunk, evict):
+    w = store.begin(key, resume=True)
+    try:
+        w.append(chunk)
+        w.commit({})
+    except OSError:
+        w.abort(keep_partial=True)
+        evict()
+        store.begin(key, resume=True).commit({})
+
+
+def checkpoint_fsync(path):
+    fd = os.open(path, os.O_WRONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def scrub_slice_settles(fd, size, want):
+    mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+    try:
+        return hashlib.sha256(mm).hexdigest() == want
+    finally:
+        mm.close()

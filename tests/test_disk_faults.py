@@ -1,0 +1,472 @@
+"""The storage-fault plane, proven against REAL injected disk faults
+(tests/chaosdisk.py — the disk twin of chaoshttp):
+
+- a disk that stays full after emergency eviction flips the node into
+  degraded read-through mode: a 32-client herd still lands byte-exact
+  off ONE upstream stream (nothing written), and the node auto-exits
+  the mode once the disk accepts writes again;
+- a disk that FILLS mid-landing switches the cohort onto the in-memory
+  relay seeded with the durably landed prefix — same stream, no second
+  fetch, and the partial + progress sidecar survive for later resume;
+- ENOSPC at commit time (meta sidecar) recovers inline: the body is
+  already durable, so evict + re-publish without refetching a byte;
+- EIO under a committed object quarantines it and the same read
+  re-fetches byte-exact — corrupt media never serves;
+- the scrubber catches a silently flipped byte, quarantines the object,
+  and the next read re-fetches byte-exact;
+- kill -9 mid-pull (subprocess, REAL SIGKILL semantics via os._exit)
+  costs the next incarnation only the unsynced tail: recovery truncates
+  to the checkpointed watermark and the resumed fetch is offset exactly
+  there — the landed prefix never re-crosses the wire;
+- a crash BETWEEN commit steps (the fault hook's crash-at-commit) leaves
+  a store that either serves byte-exact or misses cleanly — never torn.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from demodel_tpu import scrub, tier
+from demodel_tpu.store import Store
+from demodel_tpu.utils import metrics as m
+
+from .chaosdisk import DiskFaultPlan, DiskFaultSpec
+
+KEY = "diskblob00000001"
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    m.HUB.reset()
+    yield
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = Store(tmp_path / "fault-store")
+    yield s
+    s.close()
+
+
+def _blob(mb: int = 4, seed: int = 7) -> bytes:
+    one = bytes((i * 31 + seed) & 0xFF for i in range(1 << 20))
+    return one * mb
+
+
+def _counting_fetch(body: bytes, chunk: int = 256 << 10):
+    calls: list[tuple[str, int]] = []
+
+    def fetch(key: str, offset: int):
+        calls.append((key, offset))
+        for i in range(offset, len(body), chunk):
+            yield body[i:i + chunk]
+
+    return fetch, calls
+
+
+def _herd(ts: tier.TieredStore, key: str, fetch, n: int,
+          timeout: float = 60.0):
+    gate = threading.Barrier(n)
+    results: list = [None] * n
+    errors: list = [None] * n
+
+    def client(i: int) -> None:
+        try:
+            gate.wait(timeout=30)
+            results[i] = ts.read(key, fetch=fetch, timeout=timeout)
+        except BaseException as e:  # noqa: BLE001 — asserted by callers
+            errors[i] = e
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors
+
+
+# ----------------------------------------------- degraded read-through
+
+
+def test_enospc_herd_degraded_readthrough(store):
+    """Disk full from byte zero and STAYS full (eviction buys nothing,
+    the exit probe keeps failing): a 32-client herd lands byte-exact off
+    exactly one upstream stream with nothing written; clearing the fault
+    lets the next read probe its way out and finally land the bytes."""
+    body = _blob(2)
+    fetch, calls = _counting_fetch(body)
+    ts = tier.TieredStore(store, name="t-degraded")
+    plan = DiskFaultPlan(DiskFaultSpec("enospc", times=-1), seed=11)
+    try:
+        with plan:
+            results, errors = _herd(ts, KEY, fetch, 32)
+            assert errors == [None] * 32, errors
+            assert all(r == body for r in results)
+            assert calls == [(KEY, 0)]
+            assert ts.degraded()
+            # the append, its post-eviction retry — the real entry proof
+            assert plan.fired("enospc") >= 2
+            assert not store.has(KEY)  # degraded = nothing lands
+
+            # while the fault persists the re-probe fails and the node
+            # STAYS degraded — misses keep streaming through the relay
+            ts._last_probe = 0.0
+            assert ts.read(KEY, fetch=fetch) == body
+            assert ts.degraded()
+            assert len(calls) == 2
+
+        # fault cleared: the next read's immediate re-probe succeeds,
+        # degraded mode auto-exits, and the miss finally lands on disk
+        ts._last_probe = 0.0
+        assert ts.read(KEY, fetch=fetch) == body
+        assert not ts.degraded()
+        assert len(calls) == 3
+        assert store.has(KEY)
+
+        snap = m.HUB.snapshot()
+        assert snap.get("store_degraded_entries_total") == 1
+        storage = ts.describe()["storage"]
+        assert storage["degraded"] is False
+        assert storage["degraded_entries"] == 1
+    finally:
+        ts.close()
+
+
+def test_enospc_midstream_relay_switch(store):
+    """The disk fills at the 1 MiB watermark of a 4 MiB landing: the
+    cohort switches onto the in-memory relay seeded with the durable
+    prefix and the SAME upstream stream finishes the body — one fetch
+    total, every reader byte-exact, and the partial + progress sidecar
+    survive as a resume offer for when the disk drains."""
+    body = _blob(4)
+    cut = 1 << 20
+    fetch, calls = _counting_fetch(body)
+    ts = tier.TieredStore(store, name="t-midstream")
+    try:
+        with DiskFaultPlan(DiskFaultSpec("enospc", at_byte=cut,
+                                         times=-1)) as plan:
+            results, errors = _herd(ts, KEY, fetch, 8)
+            assert errors == [None] * 8, errors
+            assert all(r == body for r in results)
+            assert calls == [(KEY, 0)]  # relay continues the same stream
+            assert ts.degraded()
+            assert plan.fired("enospc") >= 2
+
+        # the durably landed prefix is still on disk, watermarked for a
+        # future resume — the degraded switch checkpointed before aborting
+        part = store.root / "partial" / KEY
+        assert part.stat().st_size == cut
+        side = json.loads((store.root / "partial"
+                           / f"{KEY}.progress").read_text())
+        assert side["offset"] == str(cut)
+        assert side["sha256"] == hashlib.sha256(body[:cut]).hexdigest()
+    finally:
+        ts.close()
+
+
+def test_commit_enospc_recovers_inline(store):
+    """ENOSPC while publishing the meta sidecar: the body is already
+    durable in the partial, so the leader evicts and re-publishes from
+    the partial — the read succeeds, the object commits, and the node
+    never enters degraded mode (the disk accepted the retry)."""
+    body = _blob(1)
+    fetch, calls = _counting_fetch(body)
+    ts = tier.TieredStore(store, name="t-commit-enospc")
+    try:
+        with DiskFaultPlan(DiskFaultSpec("enospc", op="commit",
+                                         times=1)) as plan:
+            assert ts.read(KEY, fetch=fetch) == body
+            assert plan.fired("enospc") == 1
+        assert calls == [(KEY, 0)]
+        assert not ts.degraded()
+        assert store.has(KEY)
+        assert store.get(KEY) == body
+    finally:
+        ts.close()
+
+
+# ------------------------------------------------- quarantine on EIO
+
+
+def test_eio_read_quarantines_and_refetches(store):
+    """EIO under a committed object (bad sector): the SAME read
+    quarantines it and falls through to the miss path — the caller gets
+    byte-exact data off upstream, and the suspect bytes are parked in
+    quarantine/ for post-mortem instead of being served or deleted."""
+    body = _blob(1)
+    store.put(KEY, body, {"kind": "blob"})
+    fetch, calls = _counting_fetch(body)
+    ts = tier.TieredStore(store, name="t-eio")
+    try:
+        with DiskFaultPlan(DiskFaultSpec("eio-read", times=1)) as plan:
+            assert ts.read(KEY, fetch=fetch) == body
+            assert plan.fired("eio-read") == 1
+        assert calls == [(KEY, 0)]  # quarantine re-entered the miss path
+        assert store.has(KEY)  # ...and the refetch re-committed it
+        qfile = store.root / "quarantine" / KEY
+        assert qfile.exists() and qfile.read_bytes() == body
+        assert m.HUB.snapshot().get("store_quarantined_total", 0) >= 1
+    finally:
+        ts.close()
+
+
+# ------------------------------------------------------------ scrubber
+
+
+def _flip_byte(path: Path, at: int) -> None:
+    with open(path, "r+b") as f:
+        f.seek(at)
+        b = f.read(1)
+        f.seek(at)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_scrub_quarantines_flipped_byte(store):
+    """Silent bit-rot: one flipped byte in a committed object. A scrub
+    pass re-digests the committed set, quarantines exactly the corrupt
+    object (the intact one keeps serving), and the next read re-fetches
+    byte-exact instead of serving rot."""
+    body = _blob(1)
+    other = _blob(1, seed=9)
+    store.put(KEY, body, {})
+    store.put("diskblob00000002", other, {})
+    _flip_byte(store.root / "objects" / KEY, 12345)
+
+    wrapped, objs, nbytes, mismatched = store.scrub(1 << 30)
+    assert wrapped
+    assert objs == 2 and nbytes == len(body) + len(other)
+    assert mismatched == 1
+    assert not store.has(KEY)
+    assert (store.root / "quarantine" / KEY).exists()
+    assert store.get("diskblob00000002") == other
+
+    fetch, calls = _counting_fetch(body)
+    ts = tier.TieredStore(store, name="t-scrub")
+    try:
+        assert ts.read(KEY, fetch=fetch) == body
+        assert calls == [(KEY, 0)]
+        assert store.get(KEY) == body
+    finally:
+        ts.close()
+
+
+def test_scrubber_slice_counters_and_lifecycle(store, monkeypatch):
+    """The Scrubber wrapper: slice() mirrors native counters into the
+    hub (mismatches also count as quarantines), ensure() is one thread
+    per store root gated on the interval knob, and snapshot() feeds the
+    statusz storage section."""
+    monkeypatch.setenv("DEMODEL_SCRUB_INTERVAL_SECS", "1")
+    monkeypatch.setenv("DEMODEL_SCRUB_RATE_MB_S", "64")
+    body = _blob(1)
+    store.put(KEY, body, {})
+    _flip_byte(store.root / "objects" / KEY, 777)
+
+    wrapped, objs, nbytes, mismatched = scrub.Scrubber(store).slice()
+    assert wrapped and objs == 1 and nbytes == len(body)
+    assert mismatched == 1
+    snap = m.HUB.snapshot()
+    assert snap.get("scrub_objects_total") == 1
+    assert snap.get("scrub_bytes_total") == len(body)
+    assert snap.get("scrub_mismatch_total") == 1
+    assert snap.get("scrub_passes_total") == 1
+    assert snap.get("store_quarantined_total") == 1
+
+    sc = scrub.ensure(store)
+    try:
+        assert sc is not None and sc.running()
+        assert scrub.ensure(store) is sc  # one per root
+        rows = scrub.snapshot()
+        assert any(r["root"] == str(store.root) and r["running"]
+                   for r in rows)
+    finally:
+        scrub.stop_all()
+    assert not sc.running()
+    monkeypatch.setenv("DEMODEL_SCRUB_INTERVAL_SECS", "0")
+    assert scrub.ensure(store) is None  # knob off = no thread
+
+
+# ------------------------------------------------------ crash recovery
+
+
+def test_checkpoint_recover_resume_offset(store):
+    """The checkpoint → recover → resume contract, unit-sized: a writer
+    checkpoints at 100 KiB then lands 50 KiB more and dies; recovery
+    truncates the partial back to the durable watermark (the tail may be
+    torn) and a resuming writer starts exactly there."""
+    w = store.begin(KEY)
+    w.append(b"x" * (100 << 10))
+    w.checkpoint()
+    w.append(b"y" * (50 << 10))  # past the watermark: droppable
+    w.abort(keep_partial=True)
+
+    side = json.loads((store.root / "partial"
+                       / f"{KEY}.progress").read_text())
+    assert side["offset"] == str(100 << 10)
+
+    resumed, purged = store.recover(0.0)
+    assert (resumed, purged) == (1, 0)
+    assert (store.root / "partial" / KEY).stat().st_size == 100 << 10
+
+    w2 = store.begin(KEY, resume=True)
+    try:
+        assert w2.offset == 100 << 10
+    finally:
+        w2.abort()
+
+
+def test_recover_purges_torn_partial_without_sidecar(store):
+    """A partial with no progress sidecar has no durable watermark — any
+    byte of it may be torn, so recovery purges it and the next read is a
+    clean miss, not a resume of garbage."""
+    (store.root / "partial" / KEY).write_bytes(b"torn" * 1000)
+    resumed, purged = store.recover(0.0)
+    assert (resumed, purged) == (0, 1)
+    assert not (store.root / "partial" / KEY).exists()
+    assert not store.has(KEY)
+
+
+def test_meta_without_object_is_clean_miss(store):
+    """The commit order makes the meta sidecar durable BEFORE the object
+    rename; a crash between the two leaves an orphan .meta. That orphan
+    must read as a clean miss (never a torn hit), and the key must be
+    re-fillable."""
+    (store.root / "objects" / f"{KEY}.meta").write_text(
+        json.dumps({"kind": "orphan"}))
+    assert not store.has(KEY)
+    ts = tier.TieredStore(store, name="t-orphan")
+    try:
+        with pytest.raises(KeyError):
+            ts.read(KEY)
+        body = _blob(1)
+        fetch, _calls = _counting_fetch(body)
+        assert ts.read(KEY, fetch=fetch) == body
+        assert store.get(KEY) == body
+    finally:
+        ts.close()
+
+
+# -------------------------------------- crash matrix (subprocess, slow)
+
+
+def _run_child(script: str, *args: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-c", script, *args],
+        cwd=str(REPO_ROOT), env=env, capture_output=True, text=True,
+        timeout=180)
+
+
+_CHILD_PULL = r"""
+import os, sys
+from demodel_tpu import tier
+from demodel_tpu.store import Store
+
+root, mode = sys.argv[1], sys.argv[2]
+KEY = "diskblob00000001"
+body = bytes((i * 31 + 7) & 0xFF for i in range(1 << 20)) * 4
+tier._CHECKPOINT_BYTES = 256 << 10
+
+store = Store(root)
+ts = tier.TieredStore(store, name="crash-child")
+
+def fetch(key, offset):
+    sent = 0
+    for i in range(offset, len(body), 256 << 10):
+        if mode == "kill9-mid-pull" and sent >= (1 << 20):
+            os._exit(9)  # SIGKILL shape: no flushes, no handlers
+        chunk = body[i:i + (256 << 10)]
+        sent += len(chunk)
+        yield chunk
+
+if mode == "crash-at-commit":
+    from tests.chaosdisk import DiskFaultPlan, DiskFaultSpec
+    DiskFaultPlan(DiskFaultSpec("crash-at-commit")).install()
+
+ts.read(KEY, fetch=fetch)
+os._exit(7)  # only the clean-landing control path reaches this
+"""
+
+
+@pytest.mark.slow
+def test_crash_at_commit_partial_recoverable(tmp_path):
+    """Process dies BETWEEN the body landing and the publish renames
+    (the sharpest crash shape): the next incarnation sees a clean miss,
+    recovery keeps the fully-checkpointed partial, and a resuming writer
+    publishes it without a single byte re-crossing the wire."""
+    root = tmp_path / "crash-store"
+    body = _blob(4)
+    proc = _run_child(_CHILD_PULL, str(root), "crash-at-commit")
+    assert proc.returncode == 42, proc.stderr
+
+    store = Store(root)
+    try:
+        assert not store.has(KEY)  # never torn: unpublished = miss
+        assert store.partial_size(KEY) == len(body)
+        resumed, purged = store.recover(0.0)
+        assert resumed == 1 and purged == 0
+
+        # the full body was checkpointed, so the "resume" is pure
+        # publish: offset == size, zero bytes refetched
+        w = store.begin(KEY, resume=True)
+        assert w.offset == len(body)
+        w.commit({})
+        assert store.get(KEY) == body
+    finally:
+        store.close()
+
+
+@pytest.mark.slow
+def test_kill9_mid_pull_resumes_from_watermark(tmp_path):
+    """kill -9 one MiB into a 4 MiB pull: the next incarnation recovers
+    the partial to the checkpointed watermark and its fetch resumes AT
+    that offset — the landed prefix never re-crosses the wire — landing
+    the full body byte-exact."""
+    root = tmp_path / "kill9-store"
+    body = _blob(4)
+    proc = _run_child(_CHILD_PULL, str(root), "kill9-mid-pull")
+    assert proc.returncode == 9, proc.stderr
+
+    store = Store(root)
+    try:
+        resumed, purged = store.recover(0.0)
+        assert resumed == 1 and purged == 0
+        watermark = store.partial_size(KEY)
+        assert 0 < watermark < len(body)
+        assert watermark % (256 << 10) == 0  # a checkpointed boundary
+
+        fetch, calls = _counting_fetch(body)
+        ts = tier.TieredStore(store, name="t-resume")
+        try:
+            assert ts.read(KEY, fetch=fetch) == body
+        finally:
+            ts.close()
+        # THE resume proof: one fetch, offset exactly the watermark
+        assert calls == [(KEY, watermark)]
+        assert store.get(KEY) == body
+    finally:
+        store.close()
+
+
+@pytest.mark.slow
+def test_clean_pull_control(tmp_path):
+    """Control arm for the crash matrix: the same child with no fault
+    lands and exits 7 — proving the crash exits above come from the
+    injected faults, not from the harness."""
+    root = tmp_path / "clean-store"
+    body = _blob(4)
+    proc = _run_child(_CHILD_PULL, str(root), "clean")
+    assert proc.returncode == 7, proc.stderr
+    store = Store(root)
+    try:
+        assert store.get(KEY) == body
+    finally:
+        store.close()

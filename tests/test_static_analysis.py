@@ -225,6 +225,19 @@ GOLDEN = {
         ("obligation-leak", 37),  # leaky.cc: dropped hot pin
         ("obligation-leak", 46),  # leaky.cc: splice pipe pair leaked
     },
+    # the storage-fault plane's NEW leak shapes (PR 19): a partial
+    # writer stranded when the post-eviction ENOSPC retry raises, the
+    # degraded-mode probe fd lost if the probe write raises, a scrubber
+    # mmap dropped on the mismatch early-return, and a degraded relay
+    # lease never settled when the upstream dies; the controls are the
+    # real tier idioms (handler-abort + re-publish, finally close,
+    # chained begin().commit()) and must stay silent
+    "storefault_bad.py": {
+        ("obligation-leak", 18),  # writer: ENOSPC retry may raise
+        ("obligation-leak", 28),  # probe fd: write/fsync may raise
+        ("obligation-leak", 35),  # scrub mmap: mismatch early-return
+        ("obligation-leak", 43),  # relay lease: upstream raise strands
+    },
     # the cross-module taint pair: silent when analyzed alone (neither
     # half shows both the device producer and the sync) — the findings
     # only exist when one ProjectIndex spans both files, asserted by

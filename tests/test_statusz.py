@@ -380,10 +380,11 @@ def test_statusz_snapshot_sections():
     budget.release(200)
     with trace.span("pull"):
         doc = statusz.snapshot(extra={"server": "test"})
-    assert doc["statusz"] == 2
+    assert doc["statusz"] == 3
     assert doc["server"] == "test"
     assert doc["uptime_sec"] >= 0
     assert isinstance(doc["tiers"], list)  # v2: tier section always present
+    assert isinstance(doc["storage"], dict)  # v3: storage-fault section
     assert doc["breakers"]["http://dead:1"]["state"] == "open"
     assert doc["breakers"]["http://dead:1"]["open_age_sec"] >= 0
     (b,) = [x for x in doc["budgets"] if x["name"] == "test-budget"]
@@ -408,8 +409,11 @@ def test_native_statusz_endpoint(tmp_path):
         assert resp.status == 200
         doc = json.loads(resp.read())
         conn.close()
-        assert doc["statusz"] == 2
+        assert doc["statusz"] == 3
         assert doc["server"] == "demodel-native-proxy"
+        # v3 storage-fault section (native twin)
+        assert doc["storage"]["degraded"] is False
+        assert doc["storage"]["scrub"]["interval_secs"] >= 0
         assert doc["uptime_sec"] >= 0
         assert doc["conns"]["live"] >= 1  # the statusz conn itself
         # v2 tier section: RAM occupancy/budget from the mmap hot tier

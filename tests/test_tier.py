@@ -382,3 +382,39 @@ def test_singleflight_do_collapses_and_hands_off(store):
     assert sum(1 for o in outcomes if o == "landed") == 1
     assert sum(1 for o in outcomes if o is None) == 1
     assert sf.in_flight() == 0
+
+
+# ------------------------------------------- storage-fault plane edge
+
+def test_tiny_budget_enospc_pull_avoids_degraded(store, monkeypatch):
+    """A transient ENOSPC under a squeezed DEMODEL_CACHE_MAX_GB budget:
+    the emergency enforce() eviction frees space, the single retry
+    lands the chunk, and the node never enters degraded read-through —
+    the tier sheds cached bytes, not the client's landing. (The
+    persistent-ENOSPC shape, where the retry ALSO fails, lives in
+    tests/test_disk_faults.py.)"""
+    from .chaosdisk import DiskFaultPlan, DiskFaultSpec
+
+    monkeypatch.setenv("DEMODEL_CACHE_MAX_GB", "1")
+    store.put("fillerblob000001", _blob(1, seed=3), {})  # evictable
+    body = _blob(2)
+    calls = []
+
+    def fetch(key, offset):
+        calls.append((key, offset))
+        for i in range(offset, len(body), 256 << 10):
+            yield body[i:i + (256 << 10)]
+
+    ts = tier.TieredStore(store, name="t-budget")
+    try:
+        with DiskFaultPlan(DiskFaultSpec("enospc", key=KEY,
+                                         times=1)) as plan:
+            assert ts.read(KEY, fetch=fetch) == body
+            assert plan.fired("enospc") == 1
+        assert calls == [(KEY, 0)]
+        assert not ts.degraded()
+        assert store.has(KEY)
+        assert store.get(KEY) == body
+        assert m.HUB.snapshot().get("store_degraded_entries_total") is None
+    finally:
+        ts.close()

@@ -204,11 +204,15 @@ def main() -> int:
             "demodel_tpu/ops/_flash_onchip_validated.json",
             ".recovery_fired_r05") if (REPO / p).exists()]
         subprocess.run(["git", "add", *artifacts], cwd=REPO, timeout=60)
+        # --only + explicit pathspec: a bare `git commit -m` would sweep
+        # whatever ELSE happened to be staged (a human's half-finished
+        # work-in-progress) into this automated commit
         r = subprocess.run(
-            ["git", "commit", "-m",
+            ["git", "commit", "--only", "-m",
              "Record on-chip captures from recovered tunnel window\n\n"
              "Automated by tools/on_recovery.py: bench series reps, the\n"
-             "kernel on-chip validation record, and the probe log."],
+             "kernel on-chip validation record, and the probe log.",
+             "--", *artifacts],
             cwd=REPO, capture_output=True, text=True, timeout=60)
         print(f"[recovery] artifact commit: rc={r.returncode} "
               f"{(r.stdout or r.stderr)[-200:]}", file=sys.stderr)

@@ -212,7 +212,7 @@ def validate(doc: dict, source: str) -> None:
             raise SystemExit(f"{source}: telemetry missing windows_s")
         return
     version = doc.get("statusz")
-    if version not in (1, 2):
+    if version not in (1, 2, 3):
         raise SystemExit(f"{source}: missing/unknown statusz schema version")
     native = doc.get("server") == "demodel-native-proxy"
     required = (("config", "conns", "metrics") if native else
@@ -225,6 +225,10 @@ def validate(doc: dict, source: str) -> None:
         # v2 promise on BOTH planes: tier occupancy/budget is reportable
         # (null on a native proxy running without a store)
         raise SystemExit(f"{source}: statusz v2 missing 'tiers'")
+    if version >= 3 and "storage" not in doc:
+        # v3 promise on BOTH planes: degraded-mode/quarantine/scrub state
+        # is reportable (empty on a node that holds no store)
+        raise SystemExit(f"{source}: statusz v3 missing 'storage'")
     if native and "hist" not in doc["metrics"]:
         raise SystemExit(f"{source}: native metrics missing histograms")
     if native:
