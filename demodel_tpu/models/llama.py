@@ -154,6 +154,26 @@ def _rope(x, positions, theta: float):
 # ----------------------------------------------------------------- forward
 
 
+def _head_align(x, mesh: Mesh | None):
+    """Constrain [B,T,H,hd] to a HEAD-aligned tp sharding (or replicate
+    when the head count doesn't divide tp). Without this, a column-sharded
+    projection reshape leaves each shard holding *half a head*, and the
+    rotate-half slice+concat inside :func:`_rope` crosses the shard
+    boundary — a combination this jax/XLA-CPU build miscompiles under
+    multi-axis meshes (wrong VALUES, not just wrong layout; the
+    sp-mesh odd-prompt decode divergence ROADMAP carried). Head-aligned
+    shards are also the layout TP attention wants: every later op in the
+    cache path is per-head."""
+    if mesh is None:
+        return x
+    tp = int(mesh.shape.get("tp", 1))
+    if tp <= 1:
+        return x
+    H = x.shape[2]
+    spec = P(None, None, "tp", None) if H % tp == 0 else P(None, None, None, None)
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def _attn(layer, x, cfg: LlamaConfig, positions, mesh: Mesh | None,
           kv_cache=None, cache_pos=None):
     B, T, D = x.shape
@@ -162,6 +182,13 @@ def _attn(layer, x, cfg: LlamaConfig, positions, mesh: Mesh | None,
     q = (x @ layer["q_proj"]).reshape(B, T, H, hd)
     k = (x @ layer["k_proj"]).reshape(B, T, Hkv, hd)
     v = (x @ layer["v_proj"]).reshape(B, T, Hkv, hd)
+    if kv_cache is not None:
+        # cached decode/prefill: re-align shards on the head axis BEFORE
+        # the rotate-half slicing (see _head_align). The ring branch
+        # manages its own sequence sharding and must not be re-constrained.
+        q = _head_align(q, mesh)
+        k = _head_align(k, mesh)
+        v = _head_align(v, mesh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
@@ -251,19 +278,90 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None):
     ]
 
 
-def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos):
+def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
+                       mesh: Mesh | None = None):
     """Incremental forward: ``tokens`` [B, T] appended at ``pos`` (prefill
-    with T>1, decode with T=1). Returns (logits, new_cache)."""
+    with T>1, decode with T=1). Returns (logits, new_cache). ``mesh``
+    (when the params are sharded over one) keeps the projection shards
+    head-aligned through RoPE — see :func:`_head_align`."""
     B, T = tokens.shape
     positions = pos + jnp.broadcast_to(jnp.arange(T), (B, T))
     x = params["embed"][tokens]
     new_cache = []
     for layer, kv in zip(params["layers"], cache):
-        x, nkv = _block(layer, x, cfg, positions, None, kv_cache=kv,
+        x, nkv = _block(layer, x, cfg, positions, mesh, kv_cache=kv,
                         cache_pos=pos)
         new_cache.append(nkv)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return x @ params["lm_head"], new_cache
+
+
+def step_prefill(params, tokens, cfg: LlamaConfig, mesh: Mesh | None = None):
+    """Prefill leg of the serving plane: ``tokens`` [B, T] (one sequence,
+    or a few of EQUAL length) → ``(last_logits [B, V], kv)`` where ``kv``
+    is the per-layer ``(k, v)`` pair, each [B, T, Hkv, hd] — exactly the
+    prompt's keys/values, which the caller pages out into pool blocks
+    (:mod:`demodel_tpu.serve.kvcache`). The cache is sized to the prompt,
+    so this is :func:`forward_with_cache` with nothing left over."""
+    B, T = tokens.shape
+    cache = init_cache(cfg, B, T)
+    logits, kv = forward_with_cache(params, tokens, cfg, cache, 0, mesh=mesh)
+    return logits[:, -1], kv
+
+
+def step_decode(params, tokens, cfg: LlamaConfig, cache, lengths,
+                mesh: Mesh | None = None):
+    """One continuous-batching decode step over a RAGGED batch.
+
+    ``tokens`` [B] int32 — the last sampled token of each running
+    sequence; ``cache`` per-layer ``(k, v)``, each [B, S, Hkv, hd] — a
+    dense gather of each sequence's paged blocks (rows at or past
+    ``lengths[b]`` are stale pool bytes and are masked out here);
+    ``lengths`` [B] int32 — filled prefix per sequence, so the fed token
+    sits at position ``lengths[b]`` (positions need not agree across the
+    batch — that is the whole point). Returns ``(logits [B, V], new_kv)``
+    with ``new_kv`` per-layer ``(k, v)`` each [B, 1, Hkv, hd], written
+    back into the pool by the caller: the pool owns placement, the model
+    never sees a block table. Rows padded up to a jit bucket ride along
+    with ``lengths[b] == 0`` (they attend only to themselves) and are
+    dropped by the caller."""
+    B = tokens.shape[0]
+    hd = cfg.head_dim
+    H, Hkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    positions = lengths[:, None]                      # [B, 1]
+    x = params["embed"][tokens[:, None]]              # [B, 1, D]
+    new_kv = []
+    for layer, (ck, cv) in zip(params["layers"], cache):
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ layer["q_proj"]).reshape(B, 1, H, hd)
+        k = (h @ layer["k_proj"]).reshape(B, 1, Hkv, hd)
+        v = (h @ layer["v_proj"]).reshape(B, 1, Hkv, hd)
+        q = _head_align(q, mesh)
+        k = _head_align(k, mesh)
+        v = _head_align(v, mesh)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        new_kv.append((k, v))
+        S = ck.shape[1]
+        kk = jnp.concatenate([ck, k], axis=1)         # [B, S+1, Hkv, hd]
+        vv = jnp.concatenate([cv, v], axis=1)
+        rep = H // Hkv
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * hd ** -0.5
+        kpos = jnp.arange(S + 1)
+        valid = (kpos[None, :] < lengths[:, None]) | (kpos[None, :] == S)
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+        x = x + out.reshape(B, 1, H * hd) @ layer["o_proj"]
+        y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        y = (jax.nn.silu(y @ layer["gate_proj"]) * (y @ layer["up_proj"])) \
+            @ layer["down_proj"]
+        x = x + y
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return (x @ params["lm_head"])[:, 0], new_kv
 
 
 def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
@@ -280,7 +378,7 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
         key = jax.random.key(0)
 
     prefill = jax.jit(
-        lambda p, t, c: forward_with_cache(p, t, cfg, c, 0))
+        lambda p, t, c: forward_with_cache(p, t, cfg, c, 0, mesh=mesh))
     logits, cache = prefill(params, prompt, cache)
     last = logits[:, -1]
 
@@ -294,7 +392,7 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
             tok = jnp.argmax(last, axis=-1)
         tok = tok.astype(jnp.int32)
         logits, cache = forward_with_cache(params, tok[:, None], cfg, cache,
-                                           pos)
+                                           pos, mesh=mesh)
         return (logits[:, -1], cache, pos + 1, k), tok
 
     carry = (last, cache, jnp.int32(T0), key)

@@ -21,6 +21,7 @@ restores need; actual Orbax checkpoint interop lives in
 from __future__ import annotations
 
 import json
+import queue
 import re
 import threading
 from dataclasses import dataclass
@@ -30,8 +31,30 @@ from demodel_tpu.formats import safetensors as st
 from demodel_tpu.store import Store
 from demodel_tpu.utils import metrics, trace
 from demodel_tpu.utils.logging import get_logger
+from demodel_tpu.utils.metrics import labeled
 
 log = get_logger("restore")
+
+#: pre-register the /generate HTTP outcome families (house idiom) — the
+#: serve plane itself may never be imported on this node, but the scrape
+#: should still type the surface
+for _code in ("200", "400", "500", "503", "504"):
+    metrics.HUB.inc(labeled("gen_http_total", code=_code), 0)
+
+
+def _gen_engine():
+    """Resolve the process-wide generation engine WITHOUT importing the
+    serve plane (which imports jax): an engine can only exist if this
+    process booted one (``serve.boot``/``serve.load_model``) — a
+    dep-light restore node that never serves tokens answers 503 and
+    never pays the import. Returns (serve module, engine) or (None,
+    None)."""
+    import sys
+
+    serve = sys.modules.get("demodel_tpu.serve")
+    if serve is None:
+        return None, None
+    return serve, serve.current()
 
 
 def _swarm_board(pull_id: str, host_id: str):
@@ -428,6 +451,9 @@ def make_handler(registry: RestoreRegistry, proxy=None):
             self._traced(self._post)
 
         def _post(self):
+            if self.path == "/generate":
+                self._generate()
+                return
             # finalize a streamed save: the ordered digest list becomes the
             # model registration (every blob must already be pushed)
             m = re.match(r"^/restore/(.+)/commit$", self.path)
@@ -450,6 +476,98 @@ def make_handler(registry: RestoreRegistry, proxy=None):
             metrics.HUB.inc("restore_put_total")
             self._send(200, json.dumps({"model": m.group(1),
                                         "tensors": n}).encode())
+
+        def _generate(self):  # noqa: C901
+            # the token-serving surface: tokens-in, tokens-out against
+            # the process-wide continuous-batching engine. Dep-light:
+            # no engine booted → 503, the jax import never happens here.
+            serve, engine = _gen_engine()
+            if engine is None:
+                metrics.HUB.inc(labeled("gen_http_total", code="503"))
+                self._send(503, b'{"error":"serving disabled '
+                                b'(no engine booted)"}')
+                return
+            length = self._content_length()
+            if not 0 < length <= (8 << 20):
+                self._send(411, b'{"error":"Content-Length required"}')
+                return
+            try:
+                body = json.loads(self.rfile.read(length))
+                prompt = body["prompt"]
+                if not isinstance(prompt, list) or not prompt:
+                    raise ValueError(
+                        "prompt must be a non-empty list of token ids")
+                max_new = int(body.get("max_new_tokens", 16))
+                stream = bool(body.get("stream", False))
+                timeout = float(body.get("timeout", 300.0))
+            except Exception as e:  # noqa: BLE001 — bad body → client error
+                metrics.HUB.inc(labeled("gen_http_total", code="400"))
+                self._send(400, json.dumps({"error": str(e)}).encode())
+                return
+            try:
+                req = engine.submit(prompt, max_new)
+            except serve.QueueOverflow as e:
+                # the proxy plane's admission contract: loud rejection
+                # with a backoff hint, never a silent drop
+                metrics.HUB.inc(labeled("gen_http_total", code="503"))
+                self._send(503, json.dumps({
+                    "error": str(e),
+                    "retry_after": e.retry_after}).encode(),
+                    extra={"Retry-After": str(e.retry_after)})
+                return
+            except (ValueError, RuntimeError) as e:
+                metrics.HUB.inc(labeled("gen_http_total", code="400"))
+                self._send(400, json.dumps({"error": str(e)}).encode())
+                return
+            if not stream:
+                try:
+                    toks = req.result(timeout=timeout)
+                except TimeoutError:
+                    req.cancel()
+                    metrics.HUB.inc(labeled("gen_http_total", code="504"))
+                    self._send(504, b'{"error":"generation timed out"}')
+                    return
+                except RuntimeError as e:
+                    metrics.HUB.inc(labeled("gen_http_total", code="500"))
+                    self._send(500,
+                               json.dumps({"error": str(e)}).encode())
+                    return
+                metrics.HUB.inc(labeled("gen_http_total", code="200"))
+                self._send(200, json.dumps({
+                    "id": req.id, "tokens": toks,
+                    "prompt_tokens": len(req.prompt),
+                    "queue_ms": round(
+                        ((req.started_s or req.submitted_s)
+                         - req.submitted_s) * 1e3, 3),
+                    "total_ms": round(
+                        ((req.finished_s or req.submitted_s)
+                         - req.submitted_s) * 1e3, 3)}).encode())
+                return
+            # streaming: chunked NDJSON — one {"token": id} line as each
+            # token decodes, then a {"done": true} summary line
+            metrics.HUB.inc(labeled("gen_http_total", code="200"))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def _chunk(obj) -> None:
+                data = (json.dumps(obj) + "\n").encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode()
+                                 + data + b"\r\n")
+
+            try:
+                for tok in req.iter_tokens(timeout=timeout):
+                    _chunk({"token": tok})
+                _chunk({"done": True, "id": req.id, "tokens": req.tokens})
+            except RuntimeError as e:
+                _chunk({"error": str(e)})
+            except (queue.Empty, BrokenPipeError, ConnectionResetError):
+                # consumer gone or stream stalled: evict the sequence so
+                # its blocks free now instead of decoding to a dead pipe
+                req.cancel()
+                return
+            self.wfile.write(b"0\r\n\r\n")
 
         def do_GET(self):  # noqa: C901
             self._traced(self._get)

@@ -1,0 +1,477 @@
+"""Continuous-batching scheduler: admit → prefill → interleaved decode.
+
+The Orca/vLLM serving loop over the paged pool
+(:mod:`demodel_tpu.serve.kvcache`): one engine thread advances ALL
+running sequences one token per decode step, new sequences join the
+running batch *between* steps (a prefill slots in as soon as blocks are
+free — no waiting for the batch to drain), and a finished, evicted, or
+failed sequence frees its blocks immediately. Admission reserves the
+worst case (prompt + ``max_new_tokens``) up front, so a running
+sequence can never hit an out-of-blocks wall mid-decode — the
+no-overcommit discipline the KV budget exists to enforce.
+
+Backpressure rides the proxy plane's admission contract: a full waiting
+queue answers :class:`QueueOverflow`, which the HTTP surface maps to
+503 + ``Retry-After`` (``DEMODEL_GEN_RETRY_AFTER``) — loudly rejected,
+never silently dropped; every admitted request carries an
+:class:`AdmissionTicket` that must settle exactly once.
+
+Compute stays jit-friendly: decode batches are padded to power-of-two
+batch/width buckets (padded rows decode with ``length 0`` and are
+dropped on the host side), so the number of distinct compiled shapes is
+logarithmic in batch size and sequence length.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as queue_mod
+import threading
+import time
+from collections import deque
+from typing import Any, Iterator
+
+from demodel_tpu.serve.kvcache import KVBlockPool, PoolExhausted
+from demodel_tpu.utils import trace
+from demodel_tpu.utils.env import (gen_max_batch, gen_max_new_tokens,
+                                   gen_queue_limit, gen_retry_after_s)
+from demodel_tpu.utils.logging import get_logger
+from demodel_tpu.utils.metrics import HUB, labeled
+
+log = get_logger("serve.scheduler")
+
+#: pre-register the generation families at import (house idiom)
+HUB.inc(labeled("gen_tokens_total", stage="prefill"), 0)
+HUB.inc(labeled("gen_tokens_total", stage="decode"), 0)
+HUB.inc("gen_requests_total", 0)
+HUB.inc("gen_rejected_total", 0)
+HUB.inc("gen_evicted_total", 0)
+HUB.set_gauge("gen_queue_depth", 0)
+HUB.set_gauge("gen_running", 0)
+
+_END = object()  # stream sentinel: the request is finished
+
+
+class QueueOverflow(Exception):
+    """Waiting queue is full — the HTTP surface answers 503 with
+    ``Retry-After: retry_after`` (the proxy admission contract)."""
+
+    def __init__(self, depth: int, limit: int, retry_after: int):
+        super().__init__(
+            f"generation queue full ({depth}/{limit} waiting)")
+        self.retry_after = retry_after
+
+
+class Request:
+    """One generation request, observable from any thread: a bounded
+    stream of generated token ids plus a done event. Tokens-in,
+    tokens-out — the plane serves models, not tokenizers."""
+
+    def __init__(self, rid: int, prompt: list[int], max_new_tokens: int):
+        self.id = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.tokens: list[int] = []
+        self.error: str | None = None
+        self.ticket: "AdmissionTicket | None" = None
+        self.submitted_s = time.time()
+        self.started_s: float | None = None
+        self.finished_s: float | None = None
+        self.done = threading.Event()
+        self.cancelled = threading.Event()
+        self._stream: queue_mod.Queue = queue_mod.Queue()
+
+    # -- engine side ----------------------------------------------------
+    def _emit(self, tok: int) -> None:
+        self.tokens.append(tok)
+        self._stream.put(tok)
+
+    def _close(self) -> None:
+        self.finished_s = time.time()
+        self._stream.put(_END)
+        self.done.set()
+
+    # -- consumer side --------------------------------------------------
+    def cancel(self) -> None:
+        """Ask the engine to evict this sequence at the next step
+        boundary (its blocks free immediately there)."""
+        self.cancelled.set()
+
+    def result(self, timeout: float | None = None) -> list[int]:
+        """Block until finished; the generated token ids (raises on a
+        failed/evicted request)."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still running")
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return list(self.tokens)
+
+    def iter_tokens(self, timeout: float = 60.0) -> Iterator[int]:
+        """Stream token ids as they are generated; raises on error."""
+        while True:
+            item = self._stream.get(timeout=timeout)
+            if item is _END:
+                if self.error is not None:
+                    raise RuntimeError(self.error)
+                return
+            yield item
+
+
+class AdmissionTicket:
+    """One admitted request's slot in the engine's accounting — must
+    reach :meth:`finish` exactly once (completion, eviction, or error):
+    tickets are how "zero silent drops" is checkable, the outstanding
+    count is exactly admitted-minus-settled."""
+
+    __slots__ = ("_queue", "request", "_done")
+
+    def __init__(self, queue: "AdmissionQueue", request: Request):
+        self._queue = queue
+        self.request = request
+        self._done = False
+
+    def finish(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._queue._settle()
+
+
+class AdmissionQueue:
+    """Bounded waiting room with the proxy's overflow contract."""
+
+    def __init__(self, limit: int, retry_after: int):
+        self.limit = int(limit)
+        self.retry_after = int(retry_after)
+        self._outstanding = 0
+        self._settled = 0
+        self._lock = threading.Lock()
+
+    def admit(self, request: Request, waiting: int) -> AdmissionTicket:
+        """Issue a ticket, or answer the overflow contract when
+        ``waiting`` (the scheduler's pending depth) is at the limit."""
+        with self._lock:
+            if waiting >= self.limit:
+                HUB.inc("gen_rejected_total")
+                raise QueueOverflow(waiting, self.limit, self.retry_after)
+            self._outstanding += 1
+        return AdmissionTicket(self, request)
+
+    def _settle(self) -> None:
+        with self._lock:
+            self._outstanding -= 1
+            self._settled += 1
+
+    def describe(self) -> dict[str, Any]:
+        with self._lock:
+            return {"limit": self.limit, "retry_after_s": self.retry_after,
+                    "outstanding": self._outstanding,
+                    "settled": self._settled}
+
+
+class _Seq:
+    """Engine-internal running-sequence state."""
+
+    __slots__ = ("req", "lease", "length", "last_tok", "generated")
+
+    def __init__(self, req: Request, lease, length: int, last_tok: int):
+        self.req = req
+        self.lease = lease
+        self.length = length      # KV positions written so far
+        self.last_tok = last_tok  # next token to feed
+        self.generated = 1        # last_tok itself came from the prefill
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class GenEngine:
+    """The serving loop: one thread, one model, one paged pool.
+
+    All cross-thread state (`_pending`, `_running`, `_stop`, token
+    counters) is guarded by ``_work``'s lock; the jax arrays and the
+    pool's leased bytes are engine-thread-only.
+    """
+
+    def __init__(self, params, cfg, mesh=None, *,
+                 pool: KVBlockPool | None = None,
+                 max_batch: int | None = None,
+                 queue_limit: int | None = None,
+                 max_new_tokens: int | None = None,
+                 block_tokens: int | None = None,
+                 kv_mb: int | None = None,
+                 model: str = "inline"):
+        import jax
+
+        from demodel_tpu.models import llama
+
+        self.params = params
+        self.cfg = cfg
+        self.mesh = mesh
+        self.model = model
+        self.pool = pool if pool is not None else KVBlockPool(
+            cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim,
+            block_tokens=block_tokens, budget_mb=kv_mb)
+        self.max_batch = int(max_batch or gen_max_batch())
+        self.max_new_cap = int(max_new_tokens or gen_max_new_tokens())
+        self.admission = AdmissionQueue(
+            queue_limit if queue_limit is not None else gen_queue_limit(),
+            gen_retry_after_s())
+        self._jprefill = jax.jit(
+            lambda p, t: llama.step_prefill(p, t, cfg, mesh=mesh))
+        self._jdecode = jax.jit(
+            lambda p, t, c, ln: llama.step_decode(p, t, cfg, c, ln,
+                                                  mesh=mesh))
+        self._pending: deque[Request] = deque()
+        self._running: list[_Seq] = []
+        self._stop = False
+        self._work = threading.Condition(threading.Lock())
+        self._ids = itertools.count(1)
+        self._tokens = {"prefill": 0, "decode": 0}
+        self.started_s = time.time()
+        self._thread = threading.Thread(target=self._run, name="gen-engine",
+                                        daemon=True)
+
+    # ------------------------------------------------------------ public
+    def start(self) -> "GenEngine":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the loop and settle every in-flight request (error =
+        shutdown) — blocks are freed, tickets finished, streams closed."""
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        if self._thread.ident is not None:  # tolerate never-started engines
+            self._thread.join(timeout=30)
+        with self._work:
+            leftovers = list(self._pending) + [s.req for s in self._running]
+            seqs = list(self._running)
+            self._pending.clear()
+            self._running.clear()
+        for seq in seqs:
+            seq.lease.free()
+        for req in leftovers:
+            self._finish_req(req, error="engine shutdown")
+        HUB.set_gauge("gen_queue_depth", 0)
+        HUB.set_gauge("gen_running", 0)
+
+    def submit(self, prompt, max_new_tokens: int | None = None) -> Request:
+        """Admit one request (greedy decode). Raises
+        :class:`QueueOverflow` when the waiting room is full and
+        ``ValueError`` on malformed input — both before any KV is
+        reserved."""
+        toks = [int(t) for t in prompt]
+        if not toks:
+            raise ValueError("empty prompt")
+        if any(t < 0 or t >= self.cfg.vocab_size for t in toks):
+            raise ValueError("prompt token out of vocab range")
+        want = int(max_new_tokens or self.max_new_cap)
+        want = max(1, min(want, self.max_new_cap))
+        req = Request(next(self._ids), toks, want)
+        rejected: QueueOverflow | None = None
+        with trace.span("serve.admit", request=req.id, prompt=len(toks)):
+            with self._work:
+                if self._stop:
+                    raise RuntimeError("engine stopped")
+                try:
+                    ticket = self.admission.admit(req, len(self._pending))
+                except QueueOverflow as exc:
+                    # a full waiting room is an OUTCOME, not an error —
+                    # the span records it without tripping the flight
+                    # recorder's error-root dump
+                    trace.event("rejected", retry_after=exc.retry_after)
+                    rejected = exc
+                else:
+                    req.ticket = ticket
+                    self._pending.append(req)
+                    depth = len(self._pending)
+                    self._work.notify_all()
+        if rejected is not None:
+            raise rejected
+        HUB.inc("gen_requests_total")
+        HUB.set_gauge("gen_queue_depth", depth)
+        return req
+
+    def generate(self, prompt, max_new_tokens: int | None = None,
+                 timeout: float = 300.0) -> list[int]:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(prompt, max_new_tokens).result(timeout)
+
+    def describe(self) -> dict[str, Any]:
+        with self._work:
+            waiting = len(self._pending)
+            running = len(self._running)
+            tokens = dict(self._tokens)
+        return {
+            "model": self.model,
+            "running": running,
+            "waiting": waiting,
+            "max_batch": self.max_batch,
+            "tokens": tokens,
+            "uptime_s": round(time.time() - self.started_s, 3),
+            "admission": self.admission.describe(),
+            "kv": self.pool.describe(),
+        }
+
+    # ------------------------------------------------------ engine loop
+    def _run(self) -> None:
+        while True:
+            with self._work:
+                while not self._stop and not self._pending \
+                        and not self._running:
+                    self._work.wait()
+                if self._stop:
+                    return
+            while self._admit_one():
+                pass
+            self._evict_cancelled()
+            if self._snapshot_running():
+                self._decode_step()
+
+    def _snapshot_running(self) -> list[_Seq]:
+        with self._work:
+            return list(self._running)
+
+    def _admit_one(self) -> bool:
+        """Move one waiting request into the running batch: reserve its
+        worst-case blocks, prefill, emit its first token. False when the
+        batch is full, the queue is empty, or blocks are short (head-of-
+        line waits for frees — admission order is FIFO, no starvation)."""
+        with self._work:
+            if self._stop or not self._pending \
+                    or len(self._running) >= self.max_batch:
+                return False
+            req = self._pending[0]
+            if req.cancelled.is_set():
+                self._pending.popleft()
+                depth = len(self._pending)
+            else:
+                need = self.pool.blocks_for(
+                    len(req.prompt) + req.max_new_tokens - 1)
+                try:
+                    lease = self.pool.alloc(need)
+                except PoolExhausted:
+                    return False
+                self._pending.popleft()
+                depth = len(self._pending)
+        HUB.set_gauge("gen_queue_depth", depth)
+        if req.cancelled.is_set():
+            HUB.inc("gen_evicted_total")
+            self._finish_req(req, error="cancelled before start")
+            return True
+        self._start_seq(req, lease)
+        return True
+
+    def _start_seq(self, req: Request, lease) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        req.started_s = time.time()
+        HUB.observe("gen_queue_wait_seconds",
+                    req.started_s - req.submitted_s)
+        try:
+            with trace.span("serve.prefill", request=req.id,
+                            prompt=len(req.prompt)):
+                tokens = jnp.asarray([req.prompt], jnp.int32)
+                logits, kv = self._jprefill(self.params, tokens)
+                self.pool.write_prompt(lease, kv)
+                tok0 = int(np.argmax(np.asarray(logits[0])))
+        except Exception as exc:  # noqa: BLE001 - engine must survive
+            lease.free()
+            log.error("prefill failed for request %d: %s", req.id, exc)
+            self._finish_req(req, error=f"prefill failed: {exc}")
+            return
+        seq = _Seq(req, lease, len(req.prompt), tok0)
+        with self._work:
+            self._running.append(seq)
+            running = len(self._running)
+            self._tokens["prefill"] += len(req.prompt)
+        HUB.set_gauge("gen_running", running)
+        HUB.inc(labeled("gen_tokens_total", stage="prefill"),
+                len(req.prompt))
+        req._emit(tok0)
+        HUB.inc(labeled("gen_tokens_total", stage="decode"))
+        if seq.generated >= req.max_new_tokens:
+            self._retire(seq)
+
+    def _evict_cancelled(self) -> None:
+        for seq in self._snapshot_running():
+            if seq.req.cancelled.is_set():
+                HUB.inc("gen_evicted_total")
+                self._retire(seq, error="evicted")
+
+    def _decode_step(self) -> None:
+        """Advance every running sequence one token, ragged lengths and
+        all — the continuous-batching inner loop."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        batch = self._snapshot_running()
+        if not batch:
+            return
+        B = len(batch)
+        Bb = _pow2(B)
+        bs = self.pool.block_tokens
+        width = bs * _pow2(-(-max(s.length for s in batch) // bs))
+        toks = np.zeros((Bb,), np.int32)
+        lens = np.zeros((Bb,), np.int32)
+        for i, s in enumerate(batch):
+            toks[i] = s.last_tok
+            lens[i] = s.length
+        k, v = self.pool.gather([s.lease for s in batch], width)
+        if Bb > B:  # pad rows ride along with length 0 and are dropped
+            pad = ((0, 0), (0, Bb - B)) + ((0, 0),) * (k.ndim - 2)
+            k = np.pad(k, pad)
+            v = np.pad(v, pad)
+        cache = [(jnp.asarray(k[li]), jnp.asarray(v[li]))
+                 for li in range(k.shape[0])]
+        try:
+            with trace.span("serve.decode-step", batch=B, width=width):
+                logits, new_kv = self._jdecode(
+                    self.params, jnp.asarray(toks), cache,
+                    jnp.asarray(lens))
+                out = np.asarray(logits)
+                nk = np.stack([np.asarray(lk[:, 0]) for lk, _lv in new_kv])
+                nv = np.stack([np.asarray(lv[:, 0]) for _lk, lv in new_kv])
+        except Exception as exc:  # noqa: BLE001 - engine must survive
+            log.error("decode step failed (batch=%d): %s", B, exc)
+            for seq in batch:
+                self._retire(seq, error=f"decode failed: {exc}")
+            return
+        done = 0
+        for i, seq in enumerate(batch):
+            self.pool.write_token(seq.lease, seq.length, nk[:, i], nv[:, i])
+            seq.length += 1
+            tok = int(np.argmax(out[i]))
+            seq.last_tok = tok
+            seq.generated += 1
+            seq.req._emit(tok)
+            if seq.generated >= seq.req.max_new_tokens:
+                self._retire(seq)
+                done += 1
+        with self._work:
+            self._tokens["decode"] += B
+        HUB.inc(labeled("gen_tokens_total", stage="decode"), B)
+
+    def _retire(self, seq: _Seq, error: str | None = None) -> None:
+        """Finished/evicted/failed: blocks free IMMEDIATELY (the next
+        _admit_one can use them this very iteration)."""
+        seq.lease.free()
+        with self._work:
+            if seq in self._running:
+                self._running.remove(seq)
+            running = len(self._running)
+        HUB.set_gauge("gen_running", running)
+        self._finish_req(seq.req, error=error)
+
+    def _finish_req(self, req: Request, error: str | None = None) -> None:
+        req.error = error
+        if req.ticket is not None:
+            req.ticket.finish()
+        req._close()

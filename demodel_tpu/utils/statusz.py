@@ -32,8 +32,10 @@ _START_WALL = time.time()
 
 #: v2 added the ``tiers`` section (RAM/disk occupancy, budgets, in-flight
 #: single-flight leaders) on both planes; v3 added the ``storage``
-#: section (degraded read-through state, quarantine/scrub counters)
-SCHEMA_VERSION = 3
+#: section (degraded read-through state, quarantine/scrub counters); v4
+#: added the ``generation`` section (the token-serving plane: running/
+#: waiting sequences, KV pool occupancy, admission accounting)
+SCHEMA_VERSION = 4
 
 
 def _breakers() -> dict[str, dict[str, Any]]:
@@ -97,6 +99,22 @@ def _storage() -> dict[str, Any]:
     scrub = sys.modules.get("demodel_tpu.scrub")
     if scrub is not None:
         out["scrubbers"] = scrub.snapshot()
+    return out
+
+
+def _generation() -> dict[str, Any]:
+    """Token-serving plane state: the installed engine's running/waiting
+    sequences, token counters, admission accounting, and KV pool
+    occupancy next to its budget (``sys.modules`` peek — a node that
+    never booted an engine reports an empty section and never pays the
+    serve plane's jax import)."""
+    serve = sys.modules.get("demodel_tpu.serve")
+    if serve is None:
+        return {}
+    engine = serve.current()
+    if engine is None:
+        return {}
+    out: dict[str, Any] = engine.describe()
     return out
 
 
@@ -177,6 +195,12 @@ def _knob_rows() -> list[tuple[str, Any]]:
         ("DEMODEL_STORE_REPROBE_SECS", env.store_reprobe_secs()),
         ("DEMODEL_SCRUB_INTERVAL_SECS", env.scrub_interval_secs()),
         ("DEMODEL_SCRUB_RATE_MB_S", env.scrub_rate_mb_s()),
+        ("DEMODEL_GEN_BLOCK", env.gen_block_tokens()),
+        ("DEMODEL_GEN_KV_MB", env.gen_kv_mb()),
+        ("DEMODEL_GEN_MAX_BATCH", env.gen_max_batch()),
+        ("DEMODEL_GEN_QUEUE", env.gen_queue_limit()),
+        ("DEMODEL_GEN_RETRY_AFTER", env.gen_retry_after_s()),
+        ("DEMODEL_GEN_MAX_NEW", env.gen_max_new_tokens()),
     ]
 
 
@@ -260,6 +284,7 @@ def snapshot(extra: dict[str, Any] | None = None) -> dict[str, Any]:
         "swarm": _swarm(),
         "tiers": _tiers(),
         "storage": _storage(),
+        "generation": _generation(),
         "gossip": _gossip(),
         "config": effective_config(),
         "profiler": _profiler(),

@@ -1,0 +1,68 @@
+"""Known token-serving obligation leaks; golden-tested by (rule, line).
+
+The serve plane's two paired resources: a paged KV block lease from
+``pool.alloc()`` must reach ``.free()`` exactly once, and a generation
+admission ticket from ``queue.admit()`` must reach ``.finish()``. The
+controls at the bottom are the REAL scheduler shapes (ctor ownership
+transfer, store-to-request, finally) and must stay silent.
+"""
+
+
+def discarded_lease(kv_pool):
+    kv_pool.alloc(2)  # 1: lease thrown away on the spot
+
+
+def never_freed(kv_pool, n):
+    lease = kv_pool.alloc(n)  # 2: no free on any path
+    lease.blocks.sort()
+    return None
+
+
+def dropped_ticket(admission_queue, req):
+    ticket = admission_queue.admit(req, 0)  # 3: never finished
+    req.seen = ticket.request
+    return req
+
+
+def lease_leaks_on_raise(kv_pool, prefill, n):
+    lease = kv_pool.alloc(n)  # 4: prefill() may raise, lease strands
+    out = prefill()
+    lease.free()
+    return out
+
+
+# ---- silent controls -------------------------------------------------
+
+
+class _Seq:
+    def __init__(self, lease):
+        self.lease = lease
+
+    def retire(self):
+        self.lease.free()
+
+
+def control_ctor_transfer(kv_pool, n):
+    lease = kv_pool.alloc(n)
+    return _Seq(lease)  # ownership moved into the running sequence
+
+
+def control_stored_ticket(admission_queue, req):
+    req.ticket = admission_queue.admit(req, 0)  # the request carries it
+
+
+def control_finally(kv_pool, prefill, n):
+    lease = kv_pool.alloc(n)
+    try:
+        return prefill()
+    finally:
+        lease.free()
+
+
+def control_freeing_callee(v):
+    v.free()
+
+
+def control_forwarded(kv_pool, n):
+    lease = kv_pool.alloc(n)
+    control_freeing_callee(lease)  # resolved callee releases it

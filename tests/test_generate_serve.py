@@ -1,0 +1,370 @@
+"""Token-serving plane: paged KV pool exactness, budget-bounded
+admission, continuous-batching correctness vs the one-at-a-time
+reference decoder, and the ``/generate`` HTTP contract."""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from demodel_tpu import serve
+from demodel_tpu.models import llama
+from demodel_tpu.serve import (BlockLease, GenEngine, KVBlockPool,
+                               PoolExhausted, QueueOverflow)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.key(2), cfg)
+    return params, cfg
+
+
+def _pool(cfg, **kw):
+    kw.setdefault("block_tokens", 16)
+    kw.setdefault("budget_mb", 1)
+    return KVBlockPool(cfg.num_hidden_layers, cfg.num_key_value_heads,
+                       cfg.head_dim, **kw)
+
+
+def _prompt(cfg, n, seed=0):
+    rng = random.Random(seed)
+    return [rng.randrange(cfg.vocab_size) for _ in range(n)]
+
+
+# ---------------------------------------------------------------- KV pool
+
+
+class TestKVBlockPool:
+    def test_blocks_for_rounds_up(self, tiny_model):
+        _, cfg = tiny_model
+        pool = _pool(cfg, block_tokens=16)
+        assert pool.blocks_for(1) == 1
+        assert pool.blocks_for(16) == 1
+        assert pool.blocks_for(17) == 2
+        assert pool.blocks_for(0) == 1  # floor: a sequence owns a block
+
+    def test_alloc_free_exact_under_churn(self, tiny_model):
+        """Every alloc/free cycle must account exactly: blocks AND the
+        byte budget return to their pre-cycle values, no drift."""
+        _, cfg = tiny_model
+        pool = _pool(cfg)
+        rng = random.Random(7)
+        live: list[BlockLease] = []
+        for _ in range(400):
+            if live and (rng.random() < 0.5 or pool.free_blocks < 4):
+                live.pop(rng.randrange(len(live))).free()
+            else:
+                live.append(pool.alloc(rng.randrange(1, 4)))
+            used = sum(len(ls.blocks) for ls in live)
+            assert pool.in_use_blocks == used
+            assert pool.free_blocks == pool.num_blocks - used
+            assert pool.budget.describe()["in_use_bytes"] == \
+                used * pool.block_bytes
+        for ls in live:
+            ls.free()
+        assert pool.in_use_blocks == 0
+        assert pool.budget.describe()["in_use_bytes"] == 0
+        # every block id came home exactly once
+        assert sorted(pool._free_list) == list(range(pool.num_blocks))
+
+    def test_alloc_is_all_or_nothing(self, tiny_model):
+        _, cfg = tiny_model
+        pool = _pool(cfg)
+        free = pool.free_blocks
+        with pytest.raises(PoolExhausted):
+            pool.alloc(free + 1)
+        assert pool.free_blocks == free  # no partial grant leaked
+
+    def test_double_free_is_idempotent(self, tiny_model):
+        _, cfg = tiny_model
+        pool = _pool(cfg)
+        lease = pool.alloc(3)
+        lease.free()
+        lease.free()
+        assert pool.in_use_blocks == 0
+        assert pool.budget.describe()["in_use_bytes"] == 0
+
+    def test_write_gather_roundtrip(self, tiny_model):
+        """Paged writes read back exactly through the dense gather, at
+        ragged widths and across block boundaries."""
+        _, cfg = tiny_model
+        L, Hkv, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        pool = _pool(cfg, block_tokens=4)
+        rng = np.random.default_rng(3)
+        t_a, t_b = 6, 3  # sequence lengths: spans blocks / partial block
+        lease_a = pool.alloc(pool.blocks_for(t_a + 2))
+        lease_b = pool.alloc(pool.blocks_for(t_b + 2))
+        ka = rng.normal(size=(L, 1, t_a, Hkv, hd)).astype(np.float32)
+        kb = rng.normal(size=(L, 1, t_b, Hkv, hd)).astype(np.float32)
+        pool.write_prompt(lease_a, [(ka[li], ka[li] + 1) for li in range(L)])
+        pool.write_prompt(lease_b, [(kb[li], kb[li] + 1) for li in range(L)])
+        tok = rng.normal(size=(L, Hkv, hd)).astype(np.float32)
+        pool.write_token(lease_a, t_a, tok, tok - 1)  # append one position
+        k, v = pool.gather([lease_a, lease_b], width=t_a + 1)
+        np.testing.assert_array_equal(k[:, 0, :t_a], ka[:, 0])
+        np.testing.assert_array_equal(k[:, 0, t_a], tok)
+        np.testing.assert_array_equal(v[:, 0, t_a], tok - 1)
+        np.testing.assert_array_equal(k[:, 1, :t_b], kb[:, 0])
+        np.testing.assert_array_equal(v[:, 1, :t_b], kb[:, 0] + 1)
+        lease_a.free()
+        lease_b.free()
+
+
+# ----------------------------------------------------------- scheduler
+
+
+class TestGenEngine:
+    def test_matches_one_at_a_time_reference(self, tiny_model):
+        """Continuous batching with staggered admission must produce the
+        same greedy tokens as the sequential reference decoder."""
+        params, cfg = tiny_model
+        prompts = [_prompt(cfg, n, seed=i) for i, n in
+                   enumerate([9, 5, 12, 9])]
+        max_new = 6
+        refs = [np.asarray(llama.generate(params, cfg, p, max_new))[0]
+                for p in prompts]
+        engine = GenEngine(params, cfg, max_batch=3, queue_limit=16,
+                           max_new_tokens=max_new, kv_mb=4).start()
+        try:
+            reqs = []
+            for i, p in enumerate(prompts):  # staggered: join mid-decode
+                if i == 2:
+                    reqs[0].result(timeout=120)
+                reqs.append(engine.submit(p, max_new))
+            outs = [r.result(timeout=120) for r in reqs]
+        finally:
+            engine.stop()
+        for out, ref in zip(outs, refs):
+            assert out == [int(t) for t in ref]
+        assert engine.pool.describe()["in_use_blocks"] == 0
+
+    def test_budget_bounded_admission_no_overcommit(self, tiny_model):
+        """A pool sized for two sequences serves four correct requests —
+        the extras WAIT for frees rather than overcommitting blocks."""
+        params, cfg = tiny_model
+        # block_tokens=2048 -> 512 KiB/block for tiny cfg -> 2 blocks/MiB
+        pool = _pool(cfg, block_tokens=2048, budget_mb=1)
+        assert pool.num_blocks == 2
+        max_new = 4
+        prompts = [_prompt(cfg, 7, seed=40 + i) for i in range(4)]
+        refs = [np.asarray(llama.generate(params, cfg, p, max_new))[0]
+                for p in prompts]
+        engine = GenEngine(params, cfg, pool=pool, max_batch=4,
+                           queue_limit=16, max_new_tokens=max_new).start()
+        peak = []
+        stop = threading.Event()
+
+        def watch():
+            while not stop.is_set():
+                peak.append(pool.in_use_blocks)
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        try:
+            reqs = [engine.submit(p, max_new) for p in prompts]
+            outs = [r.result(timeout=240) for r in reqs]
+        finally:
+            stop.set()
+            t.join(timeout=10)
+            engine.stop()
+        assert max(peak) <= pool.num_blocks
+        for out, ref in zip(outs, refs):
+            assert out == [int(t) for t in ref]
+        assert pool.in_use_blocks == 0
+        assert pool.budget.describe()["in_use_bytes"] == 0
+
+    def test_cancel_evicts_and_frees_blocks(self, tiny_model):
+        params, cfg = tiny_model
+        engine = GenEngine(params, cfg, max_batch=2, queue_limit=16,
+                           max_new_tokens=64, kv_mb=4).start()
+        try:
+            req = engine.submit(_prompt(cfg, 8), 64)
+            for _ in iter(req.iter_tokens(timeout=120)):
+                req.cancel()  # first token seen -> evict mid-decode
+                break
+            with pytest.raises(RuntimeError, match="evicted"):
+                req.result(timeout=120)
+            assert engine.pool.describe()["in_use_blocks"] == 0
+            # a request cancelled while still waiting also settles
+            waiting = engine.submit(_prompt(cfg, 8), 4)
+            waiting.cancel()
+            with pytest.raises(RuntimeError):
+                waiting.result(timeout=120)
+            assert engine.admission.describe()["outstanding"] == 0
+        finally:
+            engine.stop()
+
+    def test_queue_overflow_raises_with_retry_after(self, tiny_model):
+        params, cfg = tiny_model
+        engine = GenEngine(params, cfg, max_batch=1, queue_limit=2,
+                           max_new_tokens=4, kv_mb=4)  # NOT started
+        try:
+            for _ in range(2):
+                engine.submit(_prompt(cfg, 4), 2)
+            with pytest.raises(QueueOverflow) as exc:
+                engine.submit(_prompt(cfg, 4), 2)
+            assert exc.value.retry_after >= 1
+        finally:
+            engine.stop()
+
+    def test_submit_validates_before_reserving(self, tiny_model):
+        params, cfg = tiny_model
+        engine = GenEngine(params, cfg, max_batch=1, queue_limit=2,
+                           max_new_tokens=4, kv_mb=4)
+        try:
+            with pytest.raises(ValueError):
+                engine.submit([], 2)
+            with pytest.raises(ValueError):
+                engine.submit([cfg.vocab_size], 2)
+            assert engine.admission.describe()["outstanding"] == 0
+        finally:
+            engine.stop()
+
+    def test_stop_settles_pending_requests(self, tiny_model):
+        params, cfg = tiny_model
+        engine = GenEngine(params, cfg, max_batch=1, queue_limit=8,
+                           max_new_tokens=4, kv_mb=4)  # never started
+        req = engine.submit(_prompt(cfg, 4), 2)
+        engine.stop()
+        with pytest.raises(RuntimeError, match="shutdown"):
+            req.result(timeout=10)
+        assert engine.admission.describe()["outstanding"] == 0
+        with pytest.raises(RuntimeError, match="stopped"):
+            engine.submit(_prompt(cfg, 4), 2)
+
+
+# --------------------------------------------------------- HTTP surface
+
+
+def _post(url, doc, timeout=120):
+    body = json.dumps(doc).encode()
+    req = urllib.request.Request(url, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture()
+def gen_server(tmp_path):
+    from demodel_tpu.restore.server import RestoreRegistry, RestoreServer
+    from demodel_tpu.store import Store
+
+    store = Store(tmp_path / "store")
+    server = RestoreServer(RestoreRegistry(store), host="127.0.0.1").start()
+    yield f"http://127.0.0.1:{server.port}"
+    server.stop()
+    serve.install(None)
+    store.close()
+
+
+class TestGenerateHTTP:
+    def test_disabled_without_engine(self, gen_server):
+        serve.install(None)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(f"{gen_server}/generate", {"prompt": [1, 2, 3]})
+        assert exc.value.code == 503
+        assert b"serving disabled" in exc.value.read()
+
+    def test_roundtrip_matches_engine(self, gen_server, tiny_model):
+        params, cfg = tiny_model
+        prompt = _prompt(cfg, 9, seed=5)
+        ref = [int(t) for t in
+               np.asarray(llama.generate(params, cfg, prompt, 5))[0]]
+        serve.boot(params, cfg, max_batch=2, queue_limit=8,
+                   max_new_tokens=8, kv_mb=4)
+        try:
+            status, doc = _post(f"{gen_server}/generate",
+                                {"prompt": prompt, "max_new_tokens": 5})
+            assert status == 200
+            assert doc["tokens"] == ref
+            assert doc["prompt_tokens"] == len(prompt)
+            bad = urllib.request.Request(
+                f"{gen_server}/generate",
+                data=json.dumps({"prompt": []}).encode(), method="POST")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(bad, timeout=30)
+            assert exc.value.code == 400
+        finally:
+            serve.current().stop()
+
+    def test_streaming_ndjson(self, gen_server, tiny_model):
+        params, cfg = tiny_model
+        prompt = _prompt(cfg, 7, seed=6)
+        ref = [int(t) for t in
+               np.asarray(llama.generate(params, cfg, prompt, 4))[0]]
+        serve.boot(params, cfg, max_batch=2, queue_limit=8,
+                   max_new_tokens=8, kv_mb=4)
+        try:
+            body = json.dumps({"prompt": prompt, "max_new_tokens": 4,
+                               "stream": True}).encode()
+            req = urllib.request.Request(f"{gen_server}/generate",
+                                         data=body, method="POST")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.status == 200
+                assert "x-ndjson" in resp.headers.get("Content-Type", "")
+                lines = [json.loads(ln) for ln in
+                         resp.read().decode().splitlines() if ln.strip()]
+            toks = [ln["token"] for ln in lines if "token" in ln]
+            assert toks == ref
+            assert lines[-1]["done"] is True
+            assert lines[-1]["tokens"] == ref
+        finally:
+            serve.current().stop()
+
+    def test_overflow_503_sets_retry_after(self, gen_server, tiny_model):
+        params, cfg = tiny_model
+        engine = GenEngine(params, cfg, max_batch=1, queue_limit=1,
+                           max_new_tokens=4, kv_mb=4)  # not started: the
+        serve.install(engine)  # waiting room fills deterministically
+        try:
+            slow = json.dumps({"prompt": _prompt(cfg, 4),
+                               "max_new_tokens": 4}).encode()
+            hang = urllib.request.Request(f"{gen_server}/generate",
+                                          data=slow, method="POST")
+            t = threading.Thread(
+                target=lambda: urllib.request.urlopen(hang, timeout=120),
+                daemon=True)
+            t.start()
+            deadline_hit = False
+            for _ in range(200):
+                if engine.describe()["waiting"] >= 1:
+                    deadline_hit = True
+                    break
+                threading.Event().wait(0.02)
+            assert deadline_hit
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(f"{gen_server}/generate",
+                      {"prompt": _prompt(cfg, 4), "max_new_tokens": 4})
+            assert exc.value.code == 503
+            assert int(exc.value.headers["Retry-After"]) >= 1
+            doc = json.loads(exc.value.read())
+            assert doc["retry_after"] >= 1
+            engine.start()  # drain the parked request before teardown
+            t.join(timeout=120)
+        finally:
+            engine.stop()
+
+    def test_statusz_generation_section(self, gen_server, tiny_model):
+        params, cfg = tiny_model
+        serve.boot(params, cfg, max_batch=1, queue_limit=4,
+                   max_new_tokens=4, kv_mb=4)
+        try:
+            serve.current().generate(_prompt(cfg, 5), 2)
+            with urllib.request.urlopen(f"{gen_server}/debug/statusz",
+                                        timeout=30) as resp:
+                doc = json.loads(resp.read())
+            gen = doc["generation"]
+            assert gen["model"] == "inline"
+            assert gen["kv"]["in_use_blocks"] == 0
+            assert gen["tokens"]["prefill"] >= 5
+            assert gen["admission"]["outstanding"] == 0
+        finally:
+            serve.current().stop()

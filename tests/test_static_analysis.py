@@ -238,6 +238,17 @@ GOLDEN = {
         ("obligation-leak", 35),  # scrub mmap: mismatch early-return
         ("obligation-leak", 43),  # relay lease: upstream raise strands
     },
+    # the token-serving plane's paired resources (PR 20): a paged KV
+    # block lease (pool.alloc → .free()) and a generation admission
+    # ticket (queue.admit → .finish()); the controls are the real
+    # scheduler shapes — _Seq ctor ownership, req.ticket store,
+    # finally-free, releasing callee — and must stay silent
+    "serve_bad.py": {
+        ("obligation-leak", 12),  # lease discarded on the spot
+        ("obligation-leak", 16),  # lease never freed on any path
+        ("obligation-leak", 22),  # ticket never finished
+        ("obligation-leak", 28),  # lease strands if prefill() raises
+    },
     # the cross-module taint pair: silent when analyzed alone (neither
     # half shows both the device producer and the sync) — the findings
     # only exist when one ProjectIndex spans both files, asserted by
@@ -753,6 +764,47 @@ def test_guarded_field_silent_through_aliased_lock(tmp_path):
                               root=tmp_path)
     assert any(f.rule == "guarded-field" for f in active), \
         "disjoint lock sets must still race"
+
+
+def test_guarded_field_condition_over_anonymous_lock(tmp_path):
+    """``self._work = threading.Condition(threading.Lock())`` (the
+    gen-engine idiom) has no lock-named attribute to alias to — the
+    condition attribute itself must count as the lock identity, so
+    writes and reads both under ``with self._work:`` do not race."""
+    (tmp_path / "engine.py").write_text(
+        "import threading\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._work = threading.Condition(threading.Lock())\n"
+        "        self.stopping = False\n"
+        "    def halt(self):\n"
+        "        with self._work:\n"
+        "            self.stopping = True\n"
+        "    def loop(self):\n"
+        "        with self._work:\n"
+        "            return self.stopping\n"
+        "def run(ex):\n"
+        "    e = Engine()\n"
+        "    ex.submit(e.loop)\n"
+        "    e.halt()\n"
+    )
+    active, _ = analyze_paths([tmp_path], rule_ids=["guarded-field"],
+                              root=tmp_path)
+    assert active == [], [f.render() for f in active]
+
+    # control: dropping the reader's hold is still a race — the
+    # anonymous-lock identity must not blanket-silence the field
+    (tmp_path / "engine.py").write_text(
+        (tmp_path / "engine.py").read_text().replace(
+            "    def loop(self):\n"
+            "        with self._work:\n"
+            "            return self.stopping\n",
+            "    def loop(self):\n"
+            "        return self.stopping\n"))
+    active, _ = analyze_paths([tmp_path], rule_ids=["guarded-field"],
+                              root=tmp_path)
+    assert any(f.rule == "guarded-field" for f in active), \
+        "unguarded reader against a condition-held writer must fire"
 
 
 def test_guarded_field_multi_instance_worker_races_itself(tmp_path):

@@ -380,11 +380,12 @@ def test_statusz_snapshot_sections():
     budget.release(200)
     with trace.span("pull"):
         doc = statusz.snapshot(extra={"server": "test"})
-    assert doc["statusz"] == 3
+    assert doc["statusz"] == 4
     assert doc["server"] == "test"
     assert doc["uptime_sec"] >= 0
     assert isinstance(doc["tiers"], list)  # v2: tier section always present
     assert isinstance(doc["storage"], dict)  # v3: storage-fault section
+    assert isinstance(doc["generation"], dict)  # v4: token-serving plane
     assert doc["breakers"]["http://dead:1"]["state"] == "open"
     assert doc["breakers"]["http://dead:1"]["open_age_sec"] >= 0
     (b,) = [x for x in doc["budgets"] if x["name"] == "test-budget"]

@@ -43,6 +43,8 @@ BUDGETISH_RE = re.compile(r"budget", re.IGNORECASE)
 FLIGHTISH_RE = re.compile(r"flight", re.IGNORECASE)
 STOREISH_RE = re.compile(r"store", re.IGNORECASE)
 TRACEISH_RE = re.compile(r"trace|tracer", re.IGNORECASE)
+KVISH_RE = re.compile(r"kv|pool", re.IGNORECASE)
+ADMITISH_RE = re.compile(r"admission|admit|queue", re.IGNORECASE)
 
 _HTTP_VERBS = {"get", "post", "put", "patch", "delete", "head", "request"}
 
@@ -91,11 +93,17 @@ _RESPONSE = Resource(
     frozenset({"close", "release_conn"}))
 _SPAN = Resource("span", "span (release: .finish()/.end())",
                  frozenset({"finish", "end", "close"}))
+_KV = Resource("kv-lease", "paged KV block lease (release: .free())",
+               frozenset({"free"}))
+_TICKET = Resource(
+    "ticket", "generation admission ticket (release: .finish())",
+    frozenset({"finish"}))
 
 #: every release-ish method name any tracked resource recognizes — the
 #: generic set used when judging how a callee treats a PARAMETER
 ANY_RELEASE = frozenset().union(*(r.releases for r in (
-    _FD, _MMAP, _WRITER, _FLIGHT, _BUDGET, _RESPONSE, _SPAN)))
+    _FD, _MMAP, _WRITER, _FLIGHT, _BUDGET, _RESPONSE, _SPAN, _KV,
+    _TICKET)))
 
 
 def classify_acquire(call: ast.Call, recv_src: str,
@@ -129,6 +137,11 @@ def classify_acquire(call: ast.Call, recv_src: str,
     if attr in ("span", "start_span") and (
             TRACEISH_RE.search(recv_src) or "trace" in res_l):
         return _SPAN
+    if attr == "alloc" and (KVISH_RE.search(recv_src) or "pool" in res_l):
+        return _KV
+    if attr == "admit" and (ADMITISH_RE.search(recv_src)
+                            or "admission" in res_l):
+        return _TICKET
     return None
 
 

@@ -55,6 +55,7 @@ from tools.analyze.core import (
     Finding,
     ModuleContext,
     Pass,
+    dotted,
     enclosing_class,
     enclosing_function,
     register,
@@ -287,7 +288,11 @@ class GuardedFieldPass(Pass):
         # (direct alias) or ``self._cv = threading.Condition(self._lock)``
         # (a Condition ACQUIRES its underlying lock on __enter__) makes
         # the two names one lock identity — the aliased-attribute case
-        # the lock-set intersection must see through
+        # the lock-set intersection must see through. A Condition over
+        # an ANONYMOUS lock (``threading.Condition()`` / ``Condition(
+        # threading.Lock())``, the gen-engine idiom) has no second name
+        # to alias to: the condition attribute IS the lock, whatever
+        # it's called
         for node in ast.walk(ctx.tree):
             if not (isinstance(node, ast.Assign) and len(node.targets) == 1
                     and isinstance(node.targets[0], ast.Attribute)
@@ -299,15 +304,20 @@ class GuardedFieldPass(Pass):
             if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
                     and v.value.id == "self" and LOCKISH_RE.search(v.attr):
                 src_attr = v.attr
-            elif isinstance(v, ast.Call) and v.args:
+            elif isinstance(v, ast.Call):
                 fname = v.func.attr if isinstance(v.func, ast.Attribute) \
                     else (v.func.id if isinstance(v.func, ast.Name) else "")
-                a0 = v.args[0]
+                a0 = v.args[0] if v.args else None
                 if fname == "Condition" and isinstance(a0, ast.Attribute) \
                         and isinstance(a0.value, ast.Name) \
                         and a0.value.id == "self" \
                         and LOCKISH_RE.search(a0.attr):
                     src_attr = a0.attr
+                elif fname == "Condition" and (
+                        a0 is None or (isinstance(a0, ast.Call) and
+                                       (dotted(a0.func) or "").rsplit(
+                                           ".", 1)[-1].endswith("Lock"))):
+                    src_attr = node.targets[0].attr
             if src_attr is None:
                 continue
             cls = enclosing_class(node)

@@ -212,7 +212,7 @@ def validate(doc: dict, source: str) -> None:
             raise SystemExit(f"{source}: telemetry missing windows_s")
         return
     version = doc.get("statusz")
-    if version not in (1, 2, 3):
+    if version not in (1, 2, 3, 4):
         raise SystemExit(f"{source}: missing/unknown statusz schema version")
     native = doc.get("server") == "demodel-native-proxy"
     required = (("config", "conns", "metrics") if native else
@@ -229,6 +229,15 @@ def validate(doc: dict, source: str) -> None:
         # v3 promise on BOTH planes: degraded-mode/quarantine/scrub state
         # is reportable (empty on a node that holds no store)
         raise SystemExit(f"{source}: statusz v3 missing 'storage'")
+    if version >= 4 and not native:
+        # v4 promise: the token-serving plane is reportable (empty on a
+        # node that never booted a generation engine)
+        if "generation" not in doc:
+            raise SystemExit(f"{source}: statusz v4 missing 'generation'")
+        gen = doc["generation"]
+        if gen and not ("kv" in gen and "running" in gen):
+            raise SystemExit(
+                f"{source}: generation section missing kv/running")
     if native and "hist" not in doc["metrics"]:
         raise SystemExit(f"{source}: native metrics missing histograms")
     if native:
