@@ -38,7 +38,7 @@ log = get_logger("restore")
 #: pre-register the /generate HTTP outcome families (house idiom) — the
 #: serve plane itself may never be imported on this node, but the scrape
 #: should still type the surface
-for _code in ("200", "400", "500", "503", "504"):
+for _code in ("200", "400", "411", "413", "500", "503", "504"):
     metrics.HUB.inc(labeled("gen_http_total", code=_code), 0)
 
 
@@ -488,8 +488,13 @@ def make_handler(registry: RestoreRegistry, proxy=None):
                                 b'(no engine booted)"}')
                 return
             length = self._content_length()
-            if not 0 < length <= (8 << 20):
+            if length <= 0:
+                metrics.HUB.inc(labeled("gen_http_total", code="411"))
                 self._send(411, b'{"error":"Content-Length required"}')
+                return
+            if length > (8 << 20):
+                metrics.HUB.inc(labeled("gen_http_total", code="413"))
+                self._send(413, b'{"error":"body exceeds 8 MiB limit"}')
                 return
             try:
                 body = json.loads(self.rfile.read(length))

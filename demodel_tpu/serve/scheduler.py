@@ -249,6 +249,18 @@ class GenEngine:
             self._work.notify_all()
         if self._thread.ident is not None:  # tolerate never-started engines
             self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                # the engine thread is still inside a step (e.g. a long
+                # jit compile) and still writing into leased blocks —
+                # reclaiming them now would hand corruptible memory to a
+                # future engine. Leave all state for the thread to settle
+                # when it reaches the stop check.
+                with self._work:
+                    n_run, n_pend = len(self._running), len(self._pending)
+                log.error("engine thread still running after 30s; "
+                          "leaving %d leases and %d pending requests "
+                          "unreclaimed", n_run, n_pend)
+                return
         with self._work:
             leftovers = list(self._pending) + [s.req for s in self._running]
             seqs = list(self._running)
@@ -273,6 +285,17 @@ class GenEngine:
             raise ValueError("prompt token out of vocab range")
         want = int(max_new_tokens or self.max_new_cap)
         want = max(1, min(want, self.max_new_cap))
+        # a request whose worst-case reservation exceeds the whole pool
+        # can NEVER be admitted — and FIFO admission means it would wedge
+        # every request behind it. Reject it here (HTTP 400), not in the
+        # engine loop.
+        need = self.pool.blocks_for(len(toks) + want - 1)
+        if need > self.pool.num_blocks:
+            raise ValueError(
+                f"request needs {need} KV blocks (prompt {len(toks)} + "
+                f"{want} new tokens) but the pool only has "
+                f"{self.pool.num_blocks}; shorten the prompt or lower "
+                f"max_new_tokens")
         req = Request(next(self._ids), toks, want)
         rejected: QueueOverflow | None = None
         with trace.span("serve.admit", request=req.id, prompt=len(toks)):
@@ -290,12 +313,13 @@ class GenEngine:
                 else:
                     req.ticket = ticket
                     self._pending.append(req)
-                    depth = len(self._pending)
+                    # publish while still holding _work so concurrent
+                    # submitters can't regress the gauge with a stale depth
+                    HUB.inc("gen_requests_total")
+                    HUB.set_gauge("gen_queue_depth", len(self._pending))
                     self._work.notify_all()
         if rejected is not None:
             raise rejected
-        HUB.inc("gen_requests_total")
-        HUB.set_gauge("gen_queue_depth", depth)
         return req
 
     def generate(self, prompt, max_new_tokens: int | None = None,
@@ -328,11 +352,23 @@ class GenEngine:
                     self._work.wait()
                 if self._stop:
                     return
+            progressed = False
             while self._admit_one():
-                pass
+                progressed = True
             self._evict_cancelled()
             if self._snapshot_running():
                 self._decode_step()
+            elif not progressed:
+                # pending work exists but nothing could be admitted and
+                # nothing is running (shouldn't happen now that submit()
+                # rejects over-pool requests, but e.g. a leaked lease
+                # could still get here): sleep instead of busy-spinning.
+                # submit()/stop() notify; the timeout bounds recovery if
+                # a free lands without a notify.
+                with self._work:
+                    if not self._stop and self._pending \
+                            and not self._running:
+                        self._work.wait(timeout=0.05)
 
     def _snapshot_running(self) -> list[_Seq]:
         with self._work:
@@ -348,20 +384,28 @@ class GenEngine:
                     or len(self._running) >= self.max_batch:
                 return False
             req = self._pending[0]
-            if req.cancelled.is_set():
-                self._pending.popleft()
-                depth = len(self._pending)
-            else:
+            lease = None
+            if not req.cancelled.is_set():
                 need = self.pool.blocks_for(
                     len(req.prompt) + req.max_new_tokens - 1)
                 try:
                     lease = self.pool.alloc(need)
                 except PoolExhausted:
                     return False
-                self._pending.popleft()
-                depth = len(self._pending)
+                cancelled = True
+                try:
+                    cancelled = req.cancelled.is_set()
+                finally:
+                    if cancelled:
+                        # cancel landed between the head check and the
+                        # alloc — free right here or the blocks/budget
+                        # bytes leak forever
+                        lease.free()
+                        lease = None
+            self._pending.popleft()
+            depth = len(self._pending)
         HUB.set_gauge("gen_queue_depth", depth)
-        if req.cancelled.is_set():
+        if lease is None:
             HUB.inc("gen_evicted_total")
             self._finish_req(req, error="cancelled before start")
             return True

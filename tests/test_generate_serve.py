@@ -229,6 +229,55 @@ class TestGenEngine:
         finally:
             engine.stop()
 
+    def test_submit_rejects_request_larger_than_pool(self, tiny_model):
+        """A worst-case reservation larger than the whole pool can never
+        be admitted — reject at submit() (→ HTTP 400) instead of wedging
+        the FIFO head forever while the engine spins."""
+        params, cfg = tiny_model
+        pool = _pool(cfg, block_tokens=2048, budget_mb=1)
+        assert pool.num_blocks == 2
+        capacity = pool.num_blocks * pool.block_tokens
+        engine = GenEngine(params, cfg, pool=pool, max_batch=2,
+                           queue_limit=8,
+                           max_new_tokens=capacity + 64).start()
+        try:
+            with pytest.raises(ValueError, match="KV blocks"):
+                engine.submit(_prompt(cfg, 8), capacity + 8)
+            assert engine.admission.describe()["outstanding"] == 0
+            # the plane still serves: a sane request right behind it
+            out = engine.generate(_prompt(cfg, 7, seed=3), 3, timeout=240)
+            assert len(out) == 3
+        finally:
+            engine.stop()
+        assert pool.in_use_blocks == 0
+
+    def test_cancel_between_alloc_and_start_frees_lease(self, tiny_model):
+        """The narrowest cancel race: cancel() lands while _admit_one
+        holds a freshly allocated lease — the lease must be freed, not
+        dropped (a silent, permanent capacity leak otherwise)."""
+        params, cfg = tiny_model
+        engine = GenEngine(params, cfg, max_batch=2, queue_limit=8,
+                           max_new_tokens=8, kv_mb=4)  # never started:
+        req = engine.submit(_prompt(cfg, 6), 4)  # we drive _admit_one
+        real_alloc = engine.pool.alloc
+
+        def alloc_then_cancel(need):
+            lease = real_alloc(need)
+            req.cancel()  # lands after the alloc, before the start
+            return lease
+
+        engine.pool.alloc = alloc_then_cancel
+        try:
+            assert engine._admit_one() is True
+            with pytest.raises(RuntimeError, match="cancelled"):
+                req.result(timeout=10)
+            assert engine.pool.in_use_blocks == 0
+            assert engine.pool.budget.describe()["in_use_bytes"] == 0
+            assert engine.admission.describe()["outstanding"] == 0
+        finally:
+            engine.pool.alloc = real_alloc
+            engine.stop()
+
     def test_stop_settles_pending_requests(self, tiny_model):
         params, cfg = tiny_model
         engine = GenEngine(params, cfg, max_batch=1, queue_limit=8,
@@ -318,6 +367,34 @@ class TestGenerateHTTP:
             assert lines[-1]["tokens"] == ref
         finally:
             serve.current().stop()
+
+    def test_oversized_body_answers_413(self, gen_server, tiny_model):
+        """A /generate body over the 8 MiB cap is 413 Payload Too Large
+        (not a mislabeled 411), and the outcome is counted."""
+        import socket
+
+        from demodel_tpu.utils.metrics import HUB, labeled
+
+        params, cfg = tiny_model
+        engine = GenEngine(params, cfg, max_batch=1, queue_limit=1,
+                           max_new_tokens=4, kv_mb=4)  # not started
+        serve.install(engine)
+        before = HUB.get(labeled("gen_http_total", code="413"))
+        try:
+            host, port = gen_server.rsplit("/", 1)[1].split(":")
+            with socket.create_connection((host, int(port)),
+                                          timeout=30) as s:
+                # the server answers from the header alone — no need to
+                # actually ship 9 MiB
+                s.sendall(b"POST /generate HTTP/1.1\r\nHost: t\r\n"
+                          b"Content-Length: 9437184\r\n\r\n")
+                status = s.recv(4096).split(b"\r\n", 1)[0]
+            assert b"413" in status
+            assert HUB.get(labeled("gen_http_total",
+                                   code="413")) == before + 1
+        finally:
+            serve.install(None)
+            engine.stop()
 
     def test_overflow_503_sets_retry_after(self, gen_server, tiny_model):
         params, cfg = tiny_model
